@@ -1,0 +1,39 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+The reference's CI runs single-process CPU-only tests and leaves all distributed
+behavior untested (SURVEY.md §4). JAX lets us do better: every mesh/collective code
+path runs against 8 virtual CPU devices here.
+
+The session may pre-import jax pinned to a real TPU (via sitecustomize), so setting
+env vars is not enough — backends are reset after flipping the platform config.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+try:
+    import jax.extend.backend
+
+    jax.extend.backend.clear_backends()
+except Exception:
+    pass
+assert jax.devices()[0].platform == "cpu", "tests must run on the virtual CPU platform"
+
+jax.config.update("jax_default_matmul_precision", "float32")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from trlx_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(data=2, fsdp=2, model=2)

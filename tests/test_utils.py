@@ -1,0 +1,74 @@
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from trlx_tpu.utils import get_optimizer_class, get_scheduler_class, significant
+from trlx_tpu.utils.modeling import (
+    RunningMoments,
+    flatten_dict,
+    logprobs_of_labels,
+    masked_mean,
+    whiten,
+)
+
+
+@pytest.mark.parametrize("name", ["adam", "adamw", "sgd", "lion", "adamw_8bit_bnb"])
+def test_optimizer_registry(name):
+    tx = get_optimizer_class(name)(learning_rate=1e-3)
+    assert hasattr(tx, "init") and hasattr(tx, "update")
+
+
+@pytest.mark.parametrize(
+    "name,kwargs",
+    [
+        ("cosine_annealing", dict(T_max=100, eta_min=1e-6)),
+        ("linear", dict(total_steps=100)),
+        ("constant", {}),
+        ("cosine_warmup", dict(warmup_steps=10, total_steps=100)),
+    ],
+)
+def test_scheduler_registry(name, kwargs):
+    sched = get_scheduler_class(name)(learning_rate=1e-3, **kwargs)
+    assert np.isfinite(float(sched(0)))
+    assert np.isfinite(float(sched(50)))
+
+
+def test_running_moments_matches_exact():
+    rm = RunningMoments()
+    rng = np.random.default_rng(0)
+    all_xs = []
+    for _ in range(10):
+        xs = rng.normal(size=100)
+        all_xs.append(xs)
+        rm.update(xs)
+    cat = np.concatenate(all_xs)
+    assert np.isclose(rm.mean, cat.mean(), atol=1e-6)
+    assert np.isclose(rm.std, cat.std(ddof=1), atol=1e-6)
+
+
+def test_logprobs_of_labels():
+    logits = jnp.array(np.random.default_rng(1).normal(size=(2, 5, 11)), dtype=jnp.float32)
+    labels = jnp.array(np.random.default_rng(2).integers(0, 11, size=(2, 5)))
+    lp = logprobs_of_labels(logits, labels)
+    x = np.asarray(logits, dtype=np.float64)
+    ref_full = x - np.log(np.exp(x).sum(-1, keepdims=True))
+    ref = np.take_along_axis(ref_full, np.asarray(labels)[..., None], axis=-1)[..., 0]
+    assert np.allclose(np.asarray(lp), ref, atol=1e-4)
+
+
+def test_whiten_masked():
+    x = jnp.array(np.random.default_rng(3).normal(size=(4, 8)), dtype=jnp.float32)
+    mask = jnp.array(np.random.default_rng(4).integers(0, 2, size=(4, 8)), dtype=jnp.float32)
+    w = whiten(x, mask=mask)
+    m = masked_mean(w, mask)
+    assert abs(float(m)) < 1e-4
+
+
+def test_flatten_dict():
+    assert flatten_dict({"a": {"b": 1, "c": {"d": 2}}}) == {"a/b": 1, "a/c/d": 2}
+
+
+def test_significant():
+    assert significant(0.0012345) == 0.00123
+    assert significant(0) == 0

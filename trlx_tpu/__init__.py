@@ -1,0 +1,20 @@
+"""trlx_tpu — a TPU-native RLHF fine-tuning framework (capabilities of CarperAI/trlX,
+built on JAX/XLA/pjit/Pallas). Public API mirrors the reference:
+``trlx_tpu.train(...)`` (cf. `/root/reference/trlx/__init__.py`)."""
+
+__version__ = "0.1.0"
+
+from trlx_tpu.data.configs import TRLConfig
+
+
+def train(*args, **kwargs):
+    """Dispatch online (PPO), offline (ILQL) or supervised (SFT/RFT) training.
+
+    Lazy wrapper around :func:`trlx_tpu.trlx.train` so that importing the package
+    stays light (no model/trainer imports until training starts)."""
+    from trlx_tpu.trlx import train as _train
+
+    return _train(*args, **kwargs)
+
+
+__all__ = ["train", "TRLConfig", "__version__"]

@@ -1,0 +1,23 @@
+from trlx_tpu.data.configs import (
+    MeshConfig,
+    ModelConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+    TokenizerConfig,
+    TrainConfig,
+    TRLConfig,
+)
+from trlx_tpu.data.method_configs import MethodConfig, get_method, register_method
+
+__all__ = [
+    "TRLConfig",
+    "TrainConfig",
+    "ModelConfig",
+    "TokenizerConfig",
+    "OptimizerConfig",
+    "SchedulerConfig",
+    "MeshConfig",
+    "MethodConfig",
+    "register_method",
+    "get_method",
+]

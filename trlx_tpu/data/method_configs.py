@@ -1,0 +1,70 @@
+"""Method (algorithm) hyperparameter configs and their registry.
+
+Mirrors the capability of the reference's method registry
+(`/root/reference/trlx/data/method_configs.py:6-56`): every RL algorithm registers
+a dataclass holding its hyperparameters by name, and the method object also owns
+the algorithm's loss function (implemented in JAX in `trlx_tpu.models.losses`).
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+# name (lowercased) -> method config class
+_METHODS: Dict[str, type] = {}
+
+
+def register_method(name_or_cls=None):
+    """Decorator registering a method config class under its (lowercased) name.
+
+    Usage::
+
+        @register_method
+        class PPOConfig(MethodConfig): ...
+
+        @register_method("my_ppo")
+        class CustomPPO(MethodConfig): ...
+    """
+
+    def _register(cls, name=None):
+        key = (name or cls.__name__).lower()
+        _METHODS[key] = cls
+        return cls
+
+    if isinstance(name_or_cls, str):
+        return lambda cls: _register(cls, name_or_cls)
+    if name_or_cls is None:
+        return _register
+    return _register(name_or_cls)
+
+
+def get_method(name: str) -> type:
+    """Return the registered method config class for ``name``.
+
+    Raises a helpful error listing known methods otherwise.
+    """
+    key = name.lower()
+    if key in _METHODS:
+        return _METHODS[key]
+    raise ValueError(f"Unknown method {name!r}. Registered methods: {sorted(_METHODS)}")
+
+
+@dataclass
+class MethodConfig:
+    """Base config for an RL method.
+
+    :param name: registry name of the method (e.g. ``"PPOConfig"``).
+    """
+
+    name: str = "MethodConfig"
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        return cls(**config)
+
+    def to_dict(self) -> Dict[str, Any]:
+        from dataclasses import asdict
+
+        return asdict(self)
+
+
+register_method(MethodConfig)

@@ -1,0 +1,19 @@
+"""RL method definitions: each algorithm = a registered MethodConfig dataclass that
+owns its (pure-JAX) loss function, mirroring the reference's design where the method
+config carries the loss (`/root/reference/trlx/data/method_configs.py`,
+`modeling_ppo.py:175`, `modeling_ilql.py:94`). Importing this package registers all
+built-in methods."""
+
+from trlx_tpu.methods.ppo import AdaptiveKLController, FixedKLController, PPOConfig
+from trlx_tpu.methods.ilql import ILQLConfig
+from trlx_tpu.methods.sft import SFTConfig
+from trlx_tpu.methods.rft import RFTConfig
+
+__all__ = [
+    "PPOConfig",
+    "ILQLConfig",
+    "SFTConfig",
+    "RFTConfig",
+    "AdaptiveKLController",
+    "FixedKLController",
+]

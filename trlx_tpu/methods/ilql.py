@@ -1,0 +1,113 @@
+"""ILQL method: expectile V loss, double-Q TD loss, CQL regularizer, AWAC-weighted CE.
+
+Functional parity with the reference's ``ILQLConfig.loss``
+(`/root/reference/trlx/models/modeling_ilql.py:48-166`), including the index
+conventions: heads are evaluated at state positions (``states_ixs``, one more than the
+action count), Q values are gathered at the action token ids, targets use the minimum
+over (target) Q heads, and every term is normalized by the count of non-terminal
+transitions. Expressed as pure jnp on fixed shapes with masks.
+"""
+
+from dataclasses import dataclass, field
+from functools import reduce
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.data.method_configs import MethodConfig, register_method
+from trlx_tpu.utils.modeling import masked_mean
+
+
+def topk_mask(xs: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Set everything below the k-th largest value (last axis) to -inf
+    (parity: modeling_ilql.py:29-33)."""
+    if k >= xs.shape[-1]:
+        return xs
+    mintop = jax.lax.top_k(xs, k)[0][..., -1:]
+    return jnp.where(xs < mintop, -jnp.inf, xs)
+
+
+def batched_index_select(x: jnp.ndarray, idxs: jnp.ndarray) -> jnp.ndarray:
+    """Gather vectors at ``idxs`` along axis 1: [B,T,H], [B,I] -> [B,I,H]
+    (parity: modeling_ilql.py:36-45)."""
+    return jnp.take_along_axis(x, idxs[..., None], axis=1)
+
+
+@register_method
+@dataclass
+class ILQLConfig(MethodConfig):
+    """ILQL hyperparameters (same names/semantics as the reference docstring):
+    ``tau`` expectile, ``gamma`` discount, ``cql_scale``, ``awac_scale``, Polyak
+    ``alpha``, AWAC/advantage ``beta``, ``steps_for_target_q_sync``, ``two_qs``,
+    ``gen_kwargs`` (with ``beta`` consumed by advantage-shaped decoding)."""
+
+    name: str = "ILQLConfig"
+    tau: float = 0.7
+    gamma: float = 0.99
+    cql_scale: float = 0.1
+    awac_scale: float = 1.0
+    alpha: float = 0.001
+    beta: float = 0.0
+    steps_for_target_q_sync: int = 200
+    two_qs: bool = True
+    gen_kwargs: Dict[str, Any] = field(
+        default_factory=lambda: dict(max_new_tokens=56, top_k=20, beta=4.0, temperature=1.0)
+    )
+
+    def loss(self, outputs, batch) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+        """``outputs = (logits_at_actions, (qs, target_qs, vs))``; ``batch`` is an
+        :class:`trlx_tpu.data.ilql_types.ILQLBatch`.
+
+        Shapes: qs/target_qs tuples of [B, A, V] at action states; vs [B, A+1, 1];
+        ``batch.rewards`` [B, A]; ``batch.dones`` [B, A+1] (1 while non-terminal).
+        ``logits_at_actions`` [B, A, V] are the policy logits at action positions.
+        """
+        logits, (qs, target_qs, vs) = outputs
+        terminal_mask = batch.dones[:, :-1].astype(vs.dtype)
+        n_nonterminal = jnp.maximum(terminal_mask.sum(), 1.0)
+
+        # token ids actually taken at each action position: input_ids shifted left,
+        # gathered at action indices
+        actions = jnp.take_along_axis(batch.input_ids[:, 1:], batch.actions_ixs, axis=1)
+        bsize, nactions = actions.shape
+        dsize = logits.shape[-1]
+
+        Q = [jnp.take_along_axis(q, actions[..., None], axis=-1)[..., 0] for q in qs]
+        targetQs = [
+            jax.lax.stop_gradient(jnp.take_along_axis(q, actions[..., None], axis=-1)[..., 0])
+            for q in target_qs
+        ]
+        targetQ = reduce(jnp.minimum, targetQs)
+
+        V = vs[:, :-1, 0]
+        Vnext = vs[:, 1:, 0] * batch.dones[:, 1:].astype(vs.dtype)
+        Q_ = batch.rewards + self.gamma * jax.lax.stop_gradient(Vnext)
+
+        loss_q = sum(jnp.sum(((Qi - Q_) * terminal_mask) ** 2) / n_nonterminal for Qi in Q)
+
+        expectile_w = jnp.where(targetQ >= V, self.tau, 1.0 - self.tau)
+        loss_v = jnp.sum(expectile_w * (targetQ - V) ** 2 * terminal_mask) / n_nonterminal
+
+        def cql_loss(q):
+            logprobs = jax.nn.log_softmax(q, axis=-1)
+            nll = -jnp.take_along_axis(logprobs, actions[..., None], axis=-1)[..., 0]
+            return jnp.sum(nll * terminal_mask) / n_nonterminal
+
+        loss_cql = sum(cql_loss(q) for q in qs)
+
+        ce = -jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1), actions[..., None], axis=-1)[..., 0]
+        awac_weight = jax.lax.stop_gradient(jnp.exp(self.beta * (targetQ - V)))
+        loss_awac = jnp.sum(ce * awac_weight * terminal_mask) / n_nonterminal
+
+        loss = loss_q + loss_v + self.cql_scale * loss_cql + self.awac_scale * loss_awac
+
+        stats = dict(
+            losses=dict(
+                loss=loss, loss_q=loss_q, loss_v=loss_v, loss_cql=loss_cql, loss_awac=loss_awac
+            ),
+            values=dict(mean=masked_mean(V, terminal_mask)),
+            qvalues={str(ix): dict(mean=masked_mean(Q[ix], terminal_mask)) for ix in range(len(Q))},
+            awac_weight=dict(mean=masked_mean(awac_weight, terminal_mask)),
+        )
+        return loss, stats

@@ -1,0 +1,30 @@
+"""SFT method config (parity: ``SFTConfig``,
+`/root/reference/trlx/trainer/accelerate_sft_trainer.py:16-26`): plain masked
+cross-entropy on (prompt, output) dialogues; ``gen_kwargs`` drive eval generation."""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.data.method_configs import MethodConfig, register_method
+
+
+@register_method
+@dataclass
+class SFTConfig(MethodConfig):
+    name: str = "SFTConfig"
+    gen_kwargs: Dict[str, Any] = field(default_factory=lambda: dict(max_new_tokens=32))
+
+    def loss(self, logits: jnp.ndarray, labels: jnp.ndarray, loss_mask: jnp.ndarray) -> Tuple[jnp.ndarray, Dict]:
+        """Next-token CE: logits [B,T,V] vs labels [B,T], masked by ``loss_mask``
+        (0 on prompt tokens when only outputs are supervised)."""
+        shift_logits = logits[:, :-1]
+        shift_labels = labels[:, 1:]
+        shift_mask = loss_mask[:, 1:].astype(shift_logits.dtype)
+        logprobs = jax.nn.log_softmax(shift_logits, axis=-1)
+        nll = -jnp.take_along_axis(logprobs, shift_labels[..., None], axis=-1)[..., 0]
+        n = jnp.maximum(shift_mask.sum(), 1.0)
+        loss = jnp.sum(nll * shift_mask) / n
+        return loss, dict(losses=dict(loss=loss))

@@ -1,0 +1,22 @@
+from trlx_tpu.parallel.mesh import (
+    BATCH_AXES,
+    DATA_AXIS,
+    FSDP_AXIS,
+    MESH_AXES,
+    MODEL_AXIS,
+    batch_sharding,
+    batch_spec,
+    dp_size,
+    initialize_distributed,
+    make_mesh,
+    mesh_from_config,
+    put_batch,
+    replicated,
+)
+from trlx_tpu.parallel.sharding import (
+    constrain,
+    default_lm_rules,
+    make_param_shardings,
+    make_param_specs,
+    shard_params,
+)

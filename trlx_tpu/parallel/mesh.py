@@ -1,0 +1,132 @@
+"""Device-mesh runtime: the single SPMD backend of the framework.
+
+The reference ships two distributed backends (Accelerate/DeepSpeed over NCCL and
+NeMo/Megatron/Apex over NCCL — SURVEY.md §2.3, §5.8). Under JAX SPMD both collapse
+into one: a ``jax.sharding.Mesh`` with axes ``("data", "fsdp", "model")`` where
+
+- ``data``  = pure data parallelism (reference: DDP / NeMo DP groups),
+- ``fsdp``  = ZeRO-style parameter/optimizer sharding (reference: DeepSpeed ZeRO 2/3),
+- ``model`` = tensor parallelism (reference: Apex Column/RowParallelLinear), and the
+  sequence dimension of activations may additionally be sharded over ``model``
+  (reference: Megatron sequence parallelism).
+
+Collectives are inserted by XLA from shardings — psum/all_gather/reduce_scatter over
+ICI — replacing every explicit NCCL call in the reference.
+"""
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+MODEL_AXIS = "model"
+MESH_AXES = (DATA_AXIS, FSDP_AXIS, MODEL_AXIS)
+
+# Batch dims are sharded over both data axes (data-parallel + fsdp act as a combined
+# data axis for inputs, the standard JAX FSDP recipe).
+BATCH_AXES = (DATA_AXIS, FSDP_AXIS)
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None) -> None:
+    """Initialize multi-host JAX if running under a multi-process launcher.
+
+    Replaces the reference's NCCL process-group init (`accelerate_base_trainer.py:56`)
+    and slurm/MPI env plumbing (`scripts/slurm_train.sh`). No-op when single-process
+    or already initialized.
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    num_processes = os.environ.get("TRLX_NUM_PROCESSES")
+    if coordinator_address or num_processes:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=int(num_processes) if num_processes else None,
+        )
+        logger.info(
+            f"jax.distributed initialized: process {jax.process_index()}/{jax.process_count()}",
+            ranks=[-1],
+        )
+
+
+def make_mesh(
+    data: int = -1,
+    fsdp: int = 1,
+    model: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build the global ``data × fsdp × model`` mesh.
+
+    Any axis given as -1 is inferred from the device count (at most one). Axis
+    products must equal the number of devices. ``mesh_utils.create_device_mesh``
+    lays axes out so the innermost (``model``) axis maps to physically-adjacent
+    chips, keeping TP collectives on ICI.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sizes = [data, fsdp, model]
+    unknown = [i for i, s in enumerate(sizes) if s == -1]
+    if len(unknown) > 1:
+        raise ValueError(f"At most one mesh axis may be -1, got {sizes}")
+    if unknown:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if n % known != 0:
+            raise ValueError(f"Device count {n} not divisible by fixed axes {sizes}")
+        sizes[unknown[0]] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(f"Mesh {sizes} does not match device count {n}")
+    device_array = mesh_utils.create_device_mesh(sizes, devices=devices)
+    mesh = Mesh(device_array, MESH_AXES)
+    logger.info(f"Mesh: data={sizes[0]} fsdp={sizes[1]} model={sizes[2]} over {n} devices")
+    return mesh
+
+
+def mesh_from_config(mesh_config, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a mesh from a :class:`trlx_tpu.data.configs.MeshConfig`."""
+    return make_mesh(
+        data=mesh_config.data, fsdp=mesh_config.fsdp, model=mesh_config.model, devices=devices
+    )
+
+
+def batch_spec(extra_dims: int = 0) -> PartitionSpec:
+    """PartitionSpec sharding a batch-leading array over the combined data axes."""
+    return PartitionSpec(BATCH_AXES, *([None] * extra_dims))
+
+
+def batch_sharding(mesh: Mesh, extra_dims: int = 0) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(extra_dims))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def dp_size(mesh: Mesh) -> int:
+    """Total data-parallel degree (data × fsdp)."""
+    return mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
+
+
+def put_batch(mesh: Mesh, batch):
+    """Place a host-global numpy pytree onto the mesh, sharded along the batch dim.
+
+    In multi-host, each process holds the *full* global batch (single-controller style
+    data loading with identical seeds); ``jax.make_array_from_process_local_data``
+    carves out this host's shards.
+    """
+    def _put(x):
+        x = np.asarray(x)
+        sharding = batch_sharding(mesh, extra_dims=x.ndim - 1)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree.map(_put, batch)

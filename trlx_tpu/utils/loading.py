@@ -1,0 +1,25 @@
+"""Name→class resolution for trainers and pipelines (parity:
+`/root/reference/trlx/utils/loading.py:14-50`). Importing this module pulls in the
+built-in trainers/pipelines so their registry decorators run."""
+
+
+def get_trainer(name: str) -> type:
+    import trlx_tpu.trainer  # noqa: F401 — populate registry
+
+    from trlx_tpu.trainer import _TRAINERS
+
+    key = name.lower()
+    if key in _TRAINERS:
+        return _TRAINERS[key]
+    raise ValueError(f"Unknown trainer {name!r}. Registered: {sorted(_TRAINERS)}")
+
+
+def get_pipeline(name: str) -> type:
+    import trlx_tpu.pipeline  # noqa: F401 — populate registry
+
+    from trlx_tpu.pipeline import _DATAPIPELINES
+
+    key = name.lower()
+    if key in _DATAPIPELINES:
+        return _DATAPIPELINES[key]
+    raise ValueError(f"Unknown pipeline {name!r}. Registered: {sorted(_DATAPIPELINES)}")
