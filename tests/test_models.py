@@ -1,0 +1,143 @@
+"""Model-layer tests (strategy mirrors reference tests/test_models.py: forward/
+generate smoke for every family preset, hydra-vs-clean logits equivalence oracle,
+cache-vs-full-forward consistency)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.heads import sync_target_q_heads
+from trlx_tpu.models.policy import (
+    CausalLMWithILQLHeads,
+    CausalLMWithValueHead,
+    apply_hydra_branch,
+    branch_param_subtree,
+)
+from trlx_tpu.models.presets import PRESETS, get_preset
+from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
+
+TINY = dict(
+    vocab_size=32, hidden_size=16, num_layers=2, num_heads=2,
+    max_position_embeddings=32, compute_dtype=jnp.float32,
+)
+
+
+def tiny_config(family: str) -> TransformerConfig:
+    return PRESETS[family].replace(**TINY)
+
+
+@pytest.mark.parametrize("family", sorted(PRESETS))
+def test_forward_all_families(family):
+    config = tiny_config(family)
+    model = TransformerLM(config)
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (2, 8), 0, config.vocab_size)
+    mask = jnp.ones((2, 8), jnp.int32)
+    params = model.init(rng, ids, mask)["params"]
+    logits, hidden, _, _ = model.apply({"params": params}, ids, mask)
+    assert logits.shape == (2, 8, config.vocab_size)
+    assert hidden.shape == (2, 8, config.hidden_size)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_left_padding_matches_unpadded():
+    """A left-padded prompt must produce the same last-token logits as unpadded."""
+    config = tiny_config("gpt2")
+    model = TransformerLM(config)
+    rng = jax.random.PRNGKey(1)
+    ids = jax.random.randint(rng, (1, 6), 1, config.vocab_size)
+    params = model.init(rng, ids, jnp.ones((1, 6), jnp.int32))["params"]
+    logits_clean, *_ = model.apply({"params": params}, ids, jnp.ones((1, 6), jnp.int32))
+
+    padded = jnp.concatenate([jnp.zeros((1, 3), ids.dtype), ids], axis=1)
+    mask = jnp.concatenate([jnp.zeros((1, 3), jnp.int32), jnp.ones((1, 6), jnp.int32)], axis=1)
+    logits_pad, *_ = model.apply({"params": params}, padded, mask)
+    np.testing.assert_allclose(
+        np.asarray(logits_clean[0, -1]), np.asarray(logits_pad[0, -1]), atol=1e-4
+    )
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama", "gpt_neox"])
+def test_cache_decode_matches_full_forward(family):
+    """Prefill + single-token cached decode == full forward at that position."""
+    config = tiny_config(family)
+    model = TransformerLM(config)
+    rng = jax.random.PRNGKey(2)
+    T = 5
+    ids = jax.random.randint(rng, (2, T + 1), 1, config.vocab_size)
+    params = model.init(rng, ids, jnp.ones((2, T + 1), jnp.int32))["params"]
+
+    full_logits, *_ = model.apply({"params": params}, ids, jnp.ones((2, T + 1), jnp.int32))
+
+    cache = model.init_cache(2, T + 4, dtype=jnp.float32)
+    mask_prefill = jnp.concatenate([jnp.ones((2, T)), jnp.zeros((2, 4))], axis=1).astype(jnp.int32)
+    prefill_logits, _, _, cache = model.apply(
+        {"params": params}, ids[:, :T], mask_prefill, None, cache
+    )
+    np.testing.assert_allclose(
+        np.asarray(full_logits[:, :T]), np.asarray(prefill_logits), atol=1e-4
+    )
+
+    mask_decode = jnp.concatenate([jnp.ones((2, T + 1)), jnp.zeros((2, 3))], axis=1).astype(jnp.int32)
+    pos = jnp.full((2, 1), T, jnp.int32)
+    step_logits, _, _, cache = model.apply(
+        {"params": params}, ids[:, T : T + 1], mask_decode, pos, cache
+    )
+    np.testing.assert_allclose(
+        np.asarray(full_logits[:, T]), np.asarray(step_logits[:, 0]), atol=1e-4
+    )
+
+
+def test_hydra_branch_equals_full_forward():
+    """The frozen-branch forward from the branch activation must reproduce the full
+    model's logits exactly (the reference's key oracle, tests/test_models.py:109-143)."""
+    config = tiny_config("gpt2")
+    model = CausalLMWithValueHead(config)
+    rng = jax.random.PRNGKey(3)
+    ids = jax.random.randint(rng, (2, 7), 1, config.vocab_size)
+    mask = jnp.ones((2, 7), jnp.int32)
+    params = model.init(rng, ids, mask)["params"]
+
+    start = 1  # one unfrozen layer on a 2-layer model
+    logits, values, branch_hidden, _ = model.apply(
+        {"params": params}, ids, mask, branch_layer=start
+    )
+    assert values.shape == (2, 7)
+    branch_params = branch_param_subtree(params["transformer"], start, config)
+    ref_logits = apply_hydra_branch(model, branch_params, branch_hidden, mask, start)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits), atol=1e-5)
+
+
+def test_ilql_heads_shapes_and_sync():
+    config = tiny_config("gpt2")
+    model = CausalLMWithILQLHeads(config, two_qs=True)
+    rng = jax.random.PRNGKey(4)
+    ids = jax.random.randint(rng, (2, 9), 1, config.vocab_size)
+    mask = jnp.ones((2, 9), jnp.int32)
+    actions_ixs = jnp.array([[2, 3, 4], [1, 2, 3]])
+    states_ixs = jnp.array([[2, 3, 4, 5], [1, 2, 3, 4]])
+    params = model.init(rng, ids, mask, None, actions_ixs, states_ixs)["params"]
+    logits, qs, tqs, vs, _ = model.apply(
+        {"params": params}, ids, mask, None, actions_ixs, states_ixs
+    )
+    assert logits.shape == (2, 9, config.vocab_size)
+    assert len(qs) == 2 and len(tqs) == 2
+    assert qs[0].shape == (2, 3, config.vocab_size)
+    assert vs.shape == (2, 4, 1)
+
+    # Polyak sync: with alpha=1, target == q exactly
+    heads = params["ilql_heads"]
+    synced = sync_target_q_heads(heads, alpha=1.0)
+    q0 = heads["q_heads_0"]["fc_in"]["kernel"]
+    t0 = synced["target_q_heads_0"]["fc_in"]["kernel"]
+    np.testing.assert_allclose(np.asarray(q0), np.asarray(t0))
+
+
+def test_get_preset_prefix_matching():
+    assert get_preset("gpt2-imdb").pos_embedding == "learned"
+    assert get_preset("EleutherAI/pythia-160m").rope_style == "neox"
+    assert get_preset("meta-llama/Llama-2-7b-hf").glu
+    with pytest.raises(ValueError):
+        get_preset("some-unknown-arch")

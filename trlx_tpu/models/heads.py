@@ -1,0 +1,103 @@
+"""Value and ILQL head modules.
+
+Parity targets: ``make_head`` / value head (`/root/reference/trlx/models/modeling_ppo.py:
+245-263` — Linear(n, 2n) → ReLU → Linear(2n, out)), the multi-layer value *branch*
+(``make_value_branch``, :255-263), and ``ILQLHeads`` (`modeling_ilql.py:169-227` —
+V head + 1–2 Q heads + Polyak-synced target Q heads). Target-Q heads live in the same
+param tree under ``target_q_heads`` and are excluded from the optimizer by a trainable
+mask; the Polyak sync is a pure function over params (no ZeRO gather dance needed —
+params are already global arrays under SPMD).
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from trlx_tpu.models.transformer import TransformerConfig
+
+
+class MLPHead(nn.Module):
+    """Two-layer head: hidden -> 2*hidden -> ReLU -> out."""
+
+    config: TransformerConfig
+    out_dim: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        c = self.config
+        x = x.astype(c.compute_dtype)
+        h = nn.Dense(
+            c.hidden_size * 2, dtype=c.compute_dtype, param_dtype=c.param_dtype,
+            kernel_init=nn.initializers.normal(c.initializer_range), name="fc_in",
+        )(x)
+        h = jax.nn.relu(h)
+        return nn.Dense(
+            self.out_dim, dtype=jnp.float32, param_dtype=c.param_dtype,
+            kernel_init=nn.initializers.normal(c.initializer_range), name="fc_out",
+        )(h)
+
+
+class ValueHead(nn.Module):
+    """Scalar value head returning [B, T] float32 values."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, hidden: jnp.ndarray) -> jnp.ndarray:
+        return MLPHead(self.config, out_dim=1, name="value_head")(hidden)[..., 0]
+
+
+class QHead(nn.Module):
+    """Q head over the full vocab: [B, T, H] -> [B, T, V]."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, hidden: jnp.ndarray) -> jnp.ndarray:
+        return MLPHead(self.config, out_dim=self.config.vocab_size, name="q_head")(hidden)
+
+
+class ILQLHeads(nn.Module):
+    """V head + (1 or 2) Q heads + matching target Q heads.
+
+    ``__call__(states_hs, actions_hs)`` -> (qs, target_qs, vs): qs/target_qs are
+    tuples of [B, A, V] evaluated at action positions; vs is [B, S, 1] at state
+    positions (parity: modeling_ilql.py:169-227).
+    """
+
+    config: TransformerConfig
+    two_qs: bool = True
+
+    def setup(self):
+        n = 2 if self.two_qs else 1
+        self.q_heads = [MLPHead(self.config, out_dim=self.config.vocab_size) for _ in range(n)]
+        self.target_q_heads = [MLPHead(self.config, out_dim=self.config.vocab_size) for _ in range(n)]
+        self.v_head = MLPHead(self.config, out_dim=1)
+
+    def __call__(
+        self, states_hs: jnp.ndarray, actions_hs: Optional[jnp.ndarray] = None
+    ) -> Tuple[Tuple[jnp.ndarray, ...], Tuple[jnp.ndarray, ...], jnp.ndarray]:
+        if actions_hs is None:
+            actions_hs = states_hs
+        qs = tuple(q(actions_hs) for q in self.q_heads)
+        target_qs = tuple(
+            jax.lax.stop_gradient(q(actions_hs)) for q in self.target_q_heads
+        )
+        vs = self.v_head(states_hs)
+        return qs, target_qs, vs
+
+
+def sync_target_q_heads(params: dict, alpha: float) -> dict:
+    """Polyak-average q_heads into target_q_heads (parity: modeling_ilql.py:216-227):
+    ``target = alpha * q + (1 - alpha) * target``. Pure function over the ILQL heads
+    param subtree (expects keys ``q_heads_{i}`` / ``target_q_heads_{i}``)."""
+    new = dict(params)
+    for key in params:
+        if key.startswith("q_heads_"):
+            tkey = "target_" + key
+            new[tkey] = jax.tree.map(
+                lambda q, t: alpha * q + (1.0 - alpha) * t, params[key], params[tkey]
+            )
+    return new

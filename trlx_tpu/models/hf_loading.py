@@ -1,0 +1,483 @@
+"""HF checkpoint interop: load torch checkpoints into TransformerLM params and export
+back (parity: ``PreTrainedModelWrapper.from_pretrained/save_pretrained`` incl. sharded
+checkpoint merging, `/root/reference/trlx/models/modeling_base.py:44-374`).
+
+Conversion is per model family (gpt2 / gptj / gpt_neox / opt / llama). All conversions
+are bidirectional so ``save_pretrained_hf`` can export an HF-loadable directory, and a
+roundtrip test validates both directions without network access by instantiating tiny
+random HF torch models from config.
+
+Offline behavior: when ``model_path`` is not a local directory with weights, we fall
+back to a family preset with random init (tests/benchmarks in a zero-egress sandbox).
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.presets import from_hf_config, get_preset
+from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+# --------------------------------------------------------------------------- io
+
+
+def load_torch_state_dict(model_dir: str) -> Dict[str, np.ndarray]:
+    """Load (possibly sharded) torch weights from a local HF model dir into numpy."""
+    out: Dict[str, np.ndarray] = {}
+
+    def _load_safetensors(path):
+        from safetensors import safe_open
+
+        with safe_open(path, framework="np") as f:
+            for k in f.keys():
+                out[k] = f.get_tensor(k)
+
+    def _load_bin(path):
+        import torch
+
+        sd = torch.load(path, map_location="cpu", weights_only=True)
+        for k, v in sd.items():
+            out[k] = v.float().numpy() if v.dtype in (torch.bfloat16, torch.float16) else v.numpy()
+
+    for index_name, loader in (
+        ("model.safetensors.index.json", _load_safetensors),
+        ("pytorch_model.bin.index.json", _load_bin),
+    ):
+        index_path = os.path.join(model_dir, index_name)
+        if os.path.exists(index_path):
+            with open(index_path) as f:
+                index = json.load(f)
+            for shard in sorted(set(index["weight_map"].values())):
+                loader(os.path.join(model_dir, shard))
+            return out
+    for name, loader in (("model.safetensors", _load_safetensors), ("pytorch_model.bin", _load_bin)):
+        path = os.path.join(model_dir, name)
+        if os.path.exists(path):
+            loader(path)
+            return out
+    raise FileNotFoundError(f"No weights found in {model_dir}")
+
+
+# ------------------------------------------------------------------ conversions
+
+# Each family: (hf_to_params, params_to_hf). Params trees are plain nested dicts of
+# numpy arrays with TransformerLM naming.
+
+
+def _ln(sd, prefix):
+    d = {"scale": sd[f"{prefix}.weight"]}
+    if f"{prefix}.bias" in sd:
+        d["bias"] = sd[f"{prefix}.bias"]
+    return d
+
+
+def _linear(sd, prefix, transpose=True):
+    d = {"kernel": sd[f"{prefix}.weight"].T if transpose else sd[f"{prefix}.weight"]}
+    if f"{prefix}.bias" in sd:
+        d["bias"] = sd[f"{prefix}.bias"]
+    return d
+
+
+def _gpt2_to_params(sd: Dict[str, np.ndarray], c: TransformerConfig) -> Dict[str, Any]:
+    p: Dict[str, Any] = {
+        "embed_tokens": {"embedding": sd["transformer.wte.weight"]},
+        "embed_positions": {"embedding": sd["transformer.wpe.weight"]},
+        "ln_f": _ln(sd, "transformer.ln_f"),
+    }
+    H = c.hidden_size
+    for i in range(c.num_layers):
+        pre = f"transformer.h.{i}"
+        # HF Conv1D stores [in, out] — no transpose
+        ck = sd[f"{pre}.attn.c_attn.weight"]
+        cb = sd[f"{pre}.attn.c_attn.bias"]
+        p[f"layers_{i}"] = {
+            "ln_1": _ln(sd, f"{pre}.ln_1"),
+            "ln_2": _ln(sd, f"{pre}.ln_2"),
+            "attn": {
+                "q_proj": {"kernel": ck[:, :H], "bias": cb[:H]},
+                "k_proj": {"kernel": ck[:, H : 2 * H], "bias": cb[H : 2 * H]},
+                "v_proj": {"kernel": ck[:, 2 * H :], "bias": cb[2 * H :]},
+                "o_proj": _linear(sd, f"{pre}.attn.c_proj", transpose=False),
+            },
+            "mlp": {
+                "up_proj": _linear(sd, f"{pre}.mlp.c_fc", transpose=False),
+                "down_proj": _linear(sd, f"{pre}.mlp.c_proj", transpose=False),
+            },
+        }
+    return p
+
+
+def _gpt2_from_params(p: Dict[str, Any], c: TransformerConfig) -> Dict[str, np.ndarray]:
+    sd = {
+        "transformer.wte.weight": p["embed_tokens"]["embedding"],
+        "transformer.wpe.weight": p["embed_positions"]["embedding"],
+        "transformer.ln_f.weight": p["ln_f"]["scale"],
+        "transformer.ln_f.bias": p["ln_f"]["bias"],
+    }
+    for i in range(c.num_layers):
+        L = p[f"layers_{i}"]
+        pre = f"transformer.h.{i}"
+        sd[f"{pre}.ln_1.weight"] = L["ln_1"]["scale"]
+        sd[f"{pre}.ln_1.bias"] = L["ln_1"]["bias"]
+        sd[f"{pre}.ln_2.weight"] = L["ln_2"]["scale"]
+        sd[f"{pre}.ln_2.bias"] = L["ln_2"]["bias"]
+        sd[f"{pre}.attn.c_attn.weight"] = np.concatenate(
+            [L["attn"][k]["kernel"] for k in ("q_proj", "k_proj", "v_proj")], axis=1
+        )
+        sd[f"{pre}.attn.c_attn.bias"] = np.concatenate(
+            [L["attn"][k]["bias"] for k in ("q_proj", "k_proj", "v_proj")]
+        )
+        sd[f"{pre}.attn.c_proj.weight"] = L["attn"]["o_proj"]["kernel"]
+        sd[f"{pre}.attn.c_proj.bias"] = L["attn"]["o_proj"]["bias"]
+        sd[f"{pre}.mlp.c_fc.weight"] = L["mlp"]["up_proj"]["kernel"]
+        sd[f"{pre}.mlp.c_fc.bias"] = L["mlp"]["up_proj"]["bias"]
+        sd[f"{pre}.mlp.c_proj.weight"] = L["mlp"]["down_proj"]["kernel"]
+        sd[f"{pre}.mlp.c_proj.bias"] = L["mlp"]["down_proj"]["bias"]
+    return sd
+
+
+def _llama_to_params(sd, c):
+    p = {
+        "embed_tokens": {"embedding": sd["model.embed_tokens.weight"]},
+        "ln_f": {"scale": sd["model.norm.weight"]},
+    }
+    if not c.tie_word_embeddings:
+        p["lm_head"] = _linear(sd, "lm_head")
+    for i in range(c.num_layers):
+        pre = f"model.layers.{i}"
+        p[f"layers_{i}"] = {
+            "ln_1": {"scale": sd[f"{pre}.input_layernorm.weight"]},
+            "ln_2": {"scale": sd[f"{pre}.post_attention_layernorm.weight"]},
+            "attn": {
+                "q_proj": _linear(sd, f"{pre}.self_attn.q_proj"),
+                "k_proj": _linear(sd, f"{pre}.self_attn.k_proj"),
+                "v_proj": _linear(sd, f"{pre}.self_attn.v_proj"),
+                "o_proj": _linear(sd, f"{pre}.self_attn.o_proj"),
+            },
+            "mlp": {
+                "gate_proj": _linear(sd, f"{pre}.mlp.gate_proj"),
+                "up_proj": _linear(sd, f"{pre}.mlp.up_proj"),
+                "down_proj": _linear(sd, f"{pre}.mlp.down_proj"),
+            },
+        }
+    return p
+
+
+def _llama_from_params(p, c):
+    sd = {
+        "model.embed_tokens.weight": p["embed_tokens"]["embedding"],
+        "model.norm.weight": p["ln_f"]["scale"],
+    }
+    if "lm_head" in p:
+        sd["lm_head.weight"] = p["lm_head"]["kernel"].T
+    for i in range(c.num_layers):
+        L = p[f"layers_{i}"]
+        pre = f"model.layers.{i}"
+        sd[f"{pre}.input_layernorm.weight"] = L["ln_1"]["scale"]
+        sd[f"{pre}.post_attention_layernorm.weight"] = L["ln_2"]["scale"]
+        for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            sd[f"{pre}.self_attn.{name}.weight"] = L["attn"][name]["kernel"].T
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            sd[f"{pre}.mlp.{name}.weight"] = L["mlp"][name]["kernel"].T
+    return sd
+
+
+def _neox_to_params(sd, c):
+    p = {
+        "embed_tokens": {"embedding": sd["gpt_neox.embed_in.weight"]},
+        "ln_f": _ln(sd, "gpt_neox.final_layer_norm"),
+        "lm_head": _linear(sd, "embed_out"),
+    }
+    heads, hd, H = c.num_heads, c.dim_per_head, c.hidden_size
+    for i in range(c.num_layers):
+        pre = f"gpt_neox.layers.{i}"
+        qkv_w = sd[f"{pre}.attention.query_key_value.weight"]  # [3H, H], per-head interleave
+        qkv_b = sd[f"{pre}.attention.query_key_value.bias"]
+        w = qkv_w.reshape(heads, 3, hd, H)
+        b = qkv_b.reshape(heads, 3, hd)
+        mk_w = lambda j: w[:, j].reshape(heads * hd, H).T  # -> [H, H] kernel
+        mk_b = lambda j: b[:, j].reshape(heads * hd)
+        p[f"layers_{i}"] = {
+            "ln_1": _ln(sd, f"{pre}.input_layernorm"),
+            "ln_2": _ln(sd, f"{pre}.post_attention_layernorm"),
+            "attn": {
+                "q_proj": {"kernel": mk_w(0), "bias": mk_b(0)},
+                "k_proj": {"kernel": mk_w(1), "bias": mk_b(1)},
+                "v_proj": {"kernel": mk_w(2), "bias": mk_b(2)},
+                "o_proj": _linear(sd, f"{pre}.attention.dense"),
+            },
+            "mlp": {
+                "up_proj": _linear(sd, f"{pre}.mlp.dense_h_to_4h"),
+                "down_proj": _linear(sd, f"{pre}.mlp.dense_4h_to_h"),
+            },
+        }
+    return p
+
+
+def _neox_from_params(p, c):
+    sd = {
+        "gpt_neox.embed_in.weight": p["embed_tokens"]["embedding"],
+        "gpt_neox.final_layer_norm.weight": p["ln_f"]["scale"],
+        "gpt_neox.final_layer_norm.bias": p["ln_f"]["bias"],
+        "embed_out.weight": p["lm_head"]["kernel"].T,
+    }
+    heads, hd, H = c.num_heads, c.dim_per_head, c.hidden_size
+    for i in range(c.num_layers):
+        L = p[f"layers_{i}"]
+        pre = f"gpt_neox.layers.{i}"
+        sd[f"{pre}.input_layernorm.weight"] = L["ln_1"]["scale"]
+        sd[f"{pre}.input_layernorm.bias"] = L["ln_1"]["bias"]
+        sd[f"{pre}.post_attention_layernorm.weight"] = L["ln_2"]["scale"]
+        sd[f"{pre}.post_attention_layernorm.bias"] = L["ln_2"]["bias"]
+        ws = [L["attn"][k]["kernel"].T.reshape(heads, hd, H) for k in ("q_proj", "k_proj", "v_proj")]
+        bs = [L["attn"][k]["bias"].reshape(heads, hd) for k in ("q_proj", "k_proj", "v_proj")]
+        sd[f"{pre}.attention.query_key_value.weight"] = np.stack(ws, axis=1).reshape(3 * H, H)
+        sd[f"{pre}.attention.query_key_value.bias"] = np.stack(bs, axis=1).reshape(3 * H)
+        sd[f"{pre}.attention.dense.weight"] = L["attn"]["o_proj"]["kernel"].T
+        sd[f"{pre}.attention.dense.bias"] = L["attn"]["o_proj"]["bias"]
+        sd[f"{pre}.mlp.dense_h_to_4h.weight"] = L["mlp"]["up_proj"]["kernel"].T
+        sd[f"{pre}.mlp.dense_h_to_4h.bias"] = L["mlp"]["up_proj"]["bias"]
+        sd[f"{pre}.mlp.dense_4h_to_h.weight"] = L["mlp"]["down_proj"]["kernel"].T
+        sd[f"{pre}.mlp.dense_4h_to_h.bias"] = L["mlp"]["down_proj"]["bias"]
+    return sd
+
+
+def _gptj_to_params(sd, c):
+    p = {
+        "embed_tokens": {"embedding": sd["transformer.wte.weight"]},
+        "ln_f": _ln(sd, "transformer.ln_f"),
+        "lm_head": _linear(sd, "lm_head"),
+    }
+    for i in range(c.num_layers):
+        pre = f"transformer.h.{i}"
+        p[f"layers_{i}"] = {
+            "ln_1": _ln(sd, f"{pre}.ln_1"),
+            "attn": {
+                "q_proj": _linear(sd, f"{pre}.attn.q_proj"),
+                "k_proj": _linear(sd, f"{pre}.attn.k_proj"),
+                "v_proj": _linear(sd, f"{pre}.attn.v_proj"),
+                "o_proj": _linear(sd, f"{pre}.attn.out_proj"),
+            },
+            "mlp": {
+                "up_proj": _linear(sd, f"{pre}.mlp.fc_in"),
+                "down_proj": _linear(sd, f"{pre}.mlp.fc_out"),
+            },
+        }
+    return p
+
+
+def _gptj_from_params(p, c):
+    sd = {
+        "transformer.wte.weight": p["embed_tokens"]["embedding"],
+        "transformer.ln_f.weight": p["ln_f"]["scale"],
+        "transformer.ln_f.bias": p["ln_f"]["bias"],
+        "lm_head.weight": p["lm_head"]["kernel"].T,
+    }
+    if "bias" in p["lm_head"]:
+        sd["lm_head.bias"] = p["lm_head"]["bias"]
+    for i in range(c.num_layers):
+        L = p[f"layers_{i}"]
+        pre = f"transformer.h.{i}"
+        sd[f"{pre}.ln_1.weight"] = L["ln_1"]["scale"]
+        sd[f"{pre}.ln_1.bias"] = L["ln_1"]["bias"]
+        for ours, theirs in (("q_proj", "q_proj"), ("k_proj", "k_proj"), ("v_proj", "v_proj"), ("o_proj", "out_proj")):
+            sd[f"{pre}.attn.{theirs}.weight"] = L["attn"][ours]["kernel"].T
+        sd[f"{pre}.mlp.fc_in.weight"] = L["mlp"]["up_proj"]["kernel"].T
+        sd[f"{pre}.mlp.fc_in.bias"] = L["mlp"]["up_proj"]["bias"]
+        sd[f"{pre}.mlp.fc_out.weight"] = L["mlp"]["down_proj"]["kernel"].T
+        sd[f"{pre}.mlp.fc_out.bias"] = L["mlp"]["down_proj"]["bias"]
+    return sd
+
+
+def _opt_to_params(sd, c):
+    prefix = "model.decoder" if "model.decoder.embed_tokens.weight" in sd else "decoder"
+    p = {
+        "embed_tokens": {"embedding": sd[f"{prefix}.embed_tokens.weight"]},
+        "embed_positions": {"embedding": sd[f"{prefix}.embed_positions.weight"]},
+    }
+    if f"{prefix}.final_layer_norm.weight" in sd:
+        p["ln_f"] = _ln(sd, f"{prefix}.final_layer_norm")
+    for i in range(c.num_layers):
+        pre = f"{prefix}.layers.{i}"
+        p[f"layers_{i}"] = {
+            "ln_1": _ln(sd, f"{pre}.self_attn_layer_norm"),
+            "ln_2": _ln(sd, f"{pre}.final_layer_norm"),
+            "attn": {
+                "q_proj": _linear(sd, f"{pre}.self_attn.q_proj"),
+                "k_proj": _linear(sd, f"{pre}.self_attn.k_proj"),
+                "v_proj": _linear(sd, f"{pre}.self_attn.v_proj"),
+                "o_proj": _linear(sd, f"{pre}.self_attn.out_proj"),
+            },
+            "mlp": {
+                "up_proj": _linear(sd, f"{pre}.fc1"),
+                "down_proj": _linear(sd, f"{pre}.fc2"),
+            },
+        }
+    return p
+
+
+def _opt_from_params(p, c):
+    prefix = "model.decoder"
+    sd = {
+        f"{prefix}.embed_tokens.weight": p["embed_tokens"]["embedding"],
+        f"{prefix}.embed_positions.weight": p["embed_positions"]["embedding"],
+    }
+    if "ln_f" in p:
+        sd[f"{prefix}.final_layer_norm.weight"] = p["ln_f"]["scale"]
+        sd[f"{prefix}.final_layer_norm.bias"] = p["ln_f"]["bias"]
+    for i in range(c.num_layers):
+        L = p[f"layers_{i}"]
+        pre = f"{prefix}.layers.{i}"
+        sd[f"{pre}.self_attn_layer_norm.weight"] = L["ln_1"]["scale"]
+        sd[f"{pre}.self_attn_layer_norm.bias"] = L["ln_1"]["bias"]
+        sd[f"{pre}.final_layer_norm.weight"] = L["ln_2"]["scale"]
+        sd[f"{pre}.final_layer_norm.bias"] = L["ln_2"]["bias"]
+        for ours, theirs in (("q_proj", "q_proj"), ("k_proj", "k_proj"), ("v_proj", "v_proj"), ("o_proj", "out_proj")):
+            sd[f"{pre}.self_attn.{theirs}.weight"] = L["attn"][ours]["kernel"].T
+            sd[f"{pre}.self_attn.{theirs}.bias"] = L["attn"][ours]["bias"]
+        sd[f"{pre}.fc1.weight"] = L["mlp"]["up_proj"]["kernel"].T
+        sd[f"{pre}.fc1.bias"] = L["mlp"]["up_proj"]["bias"]
+        sd[f"{pre}.fc2.weight"] = L["mlp"]["down_proj"]["kernel"].T
+        sd[f"{pre}.fc2.bias"] = L["mlp"]["down_proj"]["bias"]
+    return sd
+
+
+CONVERTERS = {
+    "gpt2": (_gpt2_to_params, _gpt2_from_params),
+    "llama": (_llama_to_params, _llama_from_params),
+    "gpt_neox": (_neox_to_params, _neox_from_params),
+    "gptj": (_gptj_to_params, _gptj_from_params),
+    "opt": (_opt_to_params, _opt_from_params),
+}
+
+
+def hf_state_dict_to_params(model_type: str, sd: Dict[str, np.ndarray], config: TransformerConfig) -> Dict[str, Any]:
+    if model_type not in CONVERTERS:
+        raise ValueError(f"No converter for model_type {model_type!r}")
+    p = CONVERTERS[model_type][0](sd, config)
+    return jax.tree.map(lambda x: np.asarray(x, dtype=np.float32), p)
+
+
+def params_to_hf_state_dict(model_type: str, params: Dict[str, Any], config: TransformerConfig) -> Dict[str, np.ndarray]:
+    if model_type not in CONVERTERS:
+        raise ValueError(f"No converter for model_type {model_type!r}")
+    params = jax.tree.map(lambda x: np.asarray(jax.device_get(x), dtype=np.float32), params)
+    return CONVERTERS[model_type][1](params, config)
+
+
+# ------------------------------------------------------------------- top level
+
+
+def init_params(config: TransformerConfig, module=None, seed: int = 0) -> Dict[str, Any]:
+    """Random-init trunk params (for offline runs and tests)."""
+    module = module or TransformerLM(config)
+    ids = jnp.zeros((1, 2), jnp.int32)
+    return module.init(jax.random.PRNGKey(seed), ids, jnp.ones((1, 2), jnp.int32))["params"]
+
+
+def load_pretrained(
+    model_path: str,
+    overrides: Optional[Dict[str, Any]] = None,
+) -> Tuple[TransformerConfig, Optional[Dict[str, Any]], str]:
+    """Resolve (config, trunk params or None, model_type) for a model path.
+
+    Local dir with config.json + weights → converted checkpoint. Otherwise a family
+    preset with no params (caller random-inits) — the zero-egress fallback.
+    """
+    config_path = os.path.join(model_path, "config.json")
+    if os.path.isdir(model_path) and os.path.exists(config_path):
+        import transformers
+
+        hf_config = transformers.AutoConfig.from_pretrained(model_path)
+        config = from_hf_config(hf_config, overrides)
+        sd = load_torch_state_dict(model_path)
+        params = hf_state_dict_to_params(hf_config.model_type, sd, config)
+        return config, params, hf_config.model_type
+    config = get_preset(model_path, overrides)
+    model_type = _family_of(model_path)
+    logger.warning(
+        f"No local checkpoint at {model_path!r}; using random-init {model_type} preset "
+        "(zero-egress environment)"
+    )
+    return config, None, model_type
+
+
+def _family_of(name: str) -> str:
+    key = name.lower().replace("-", "").replace("_", "")
+    for family in ("gptneox", "gptj", "gpt2", "llama", "opt"):
+        if family in key:
+            return {"gptneox": "gpt_neox"}.get(family, family)
+    if "pythia" in key or "neox" in key:
+        return "gpt_neox"
+    return "gpt2"
+
+
+def save_pretrained_hf(
+    out_dir: str,
+    model_type: str,
+    params: Dict[str, Any],
+    config: TransformerConfig,
+    hf_config=None,
+) -> None:
+    """Export trunk params as an HF-format directory (safetensors + config.json),
+    parity with the reference's ``save_pretrained`` hf_model export
+    (accelerate_base_trainer.py:284-307)."""
+    os.makedirs(out_dir, exist_ok=True)
+    sd = params_to_hf_state_dict(model_type, params, config)
+    from safetensors.numpy import save_file
+
+    save_file({k: np.ascontiguousarray(v) for k, v in sd.items()}, os.path.join(out_dir, "model.safetensors"))
+    if hf_config is None:
+        hf_config = make_hf_config(model_type, config)
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        f.write(hf_config.to_json_string())
+
+
+def make_hf_config(model_type: str, c: TransformerConfig):
+    import transformers
+
+    if model_type == "gpt2":
+        return transformers.GPT2Config(
+            vocab_size=c.vocab_size, n_embd=c.hidden_size, n_layer=c.num_layers,
+            n_head=c.num_heads, n_positions=c.max_position_embeddings,
+            layer_norm_epsilon=c.norm_eps,
+        )
+    if model_type == "llama":
+        return transformers.LlamaConfig(
+            vocab_size=c.vocab_size, hidden_size=c.hidden_size,
+            num_hidden_layers=c.num_layers, num_attention_heads=c.num_heads,
+            num_key_value_heads=c.kv_heads, intermediate_size=c.ffn_dim,
+            max_position_embeddings=c.max_position_embeddings, rms_norm_eps=c.norm_eps,
+            rope_theta=c.rope_theta, tie_word_embeddings=c.tie_word_embeddings,
+        )
+    if model_type == "gpt_neox":
+        return transformers.GPTNeoXConfig(
+            vocab_size=c.vocab_size, hidden_size=c.hidden_size,
+            num_hidden_layers=c.num_layers, num_attention_heads=c.num_heads,
+            intermediate_size=c.ffn_dim, max_position_embeddings=c.max_position_embeddings,
+            rotary_pct=c.rotary_pct, layer_norm_eps=c.norm_eps,
+            use_parallel_residual=c.parallel_residual,
+        )
+    if model_type == "gptj":
+        return transformers.GPTJConfig(
+            vocab_size=c.vocab_size, n_embd=c.hidden_size, n_layer=c.num_layers,
+            n_head=c.num_heads, n_positions=c.max_position_embeddings,
+            rotary_dim=int(c.dim_per_head * c.rotary_pct), layer_norm_epsilon=c.norm_eps,
+        )
+    if model_type == "opt":
+        return transformers.OPTConfig(
+            vocab_size=c.vocab_size, hidden_size=c.hidden_size,
+            num_hidden_layers=c.num_layers, num_attention_heads=c.num_heads,
+            ffn_dim=c.ffn_dim, max_position_embeddings=c.max_position_embeddings,
+            do_layer_norm_before=True,
+        )
+    raise ValueError(f"No HF config factory for {model_type!r}")

@@ -1,0 +1,145 @@
+"""Policy/value/ref model composition for PPO and ILQL.
+
+Parity targets (all in `/root/reference/trlx/models/`):
+- ``AutoModelForCausalLMWithValueHead`` (modeling_ppo.py:266-382): trunk + value head.
+- ``AutoModelForCausalLMWithHydraValueHead`` (modeling_ppo.py:385-453): adds a frozen
+  top-branch reference model run from the branch-point hidden state. In JAX this needs
+  NO per-architecture branch classes: the frozen branch is the same ``TransformerLM``
+  module applied with a *separate frozen param subtree* via ``method="forward_from"``.
+- ``AutoModelForCausalLMWithILQLHeads`` (modeling_ilql.py:262-442): trunk + ILQL heads
+  evaluated at gathered state/action positions.
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from flax.core import freeze, unfreeze
+
+from trlx_tpu.methods.ilql import batched_index_select
+from trlx_tpu.models.heads import ILQLHeads, ValueHead
+from trlx_tpu.models.transformer import KVCache, TransformerConfig, TransformerLM
+
+
+class CausalLMWithValueHead(nn.Module):
+    """Trunk LM + scalar value head. ``branch_layer`` (when set in a call) returns the
+    activation entering that layer, for the hydra reference branch."""
+
+    config: TransformerConfig
+
+    def setup(self):
+        self.transformer = TransformerLM(self.config)
+        self.v_head = ValueHead(self.config)
+
+    def __call__(
+        self,
+        input_ids: jnp.ndarray,
+        attention_mask: Optional[jnp.ndarray] = None,
+        positions: Optional[jnp.ndarray] = None,
+        cache: Optional[KVCache] = None,
+        branch_layer: Optional[int] = None,
+    ):
+        logits, hidden, branch_hidden, new_cache = self.transformer(
+            input_ids, attention_mask, positions, cache, branch_layer
+        )
+        values = self.v_head(hidden)
+        return logits, values, branch_hidden, new_cache
+
+    def lm_only(
+        self,
+        input_ids: jnp.ndarray,
+        attention_mask: Optional[jnp.ndarray] = None,
+        positions: Optional[jnp.ndarray] = None,
+        cache: Optional[KVCache] = None,
+    ):
+        """Forward without the value head (generation decode steps)."""
+        logits, _, _, new_cache = self.transformer(input_ids, attention_mask, positions, cache)
+        return logits, new_cache
+
+    def forward_branch(
+        self,
+        hidden: jnp.ndarray,
+        attention_mask: Optional[jnp.ndarray],
+        positions: Optional[jnp.ndarray],
+        start_layer: int,
+    ):
+        """Frozen-branch forward (hydra): run layers[start_layer:] + head from a
+        cached activation. Call with the frozen param subtree."""
+        return self.transformer.forward_from(hidden, attention_mask, positions, start_layer)
+
+    def init_cache(self, batch_size: int, max_length: int) -> KVCache:
+        return self.transformer_init_cache(batch_size, max_length)
+
+    def transformer_init_cache(self, batch_size: int, max_length: int) -> KVCache:
+        # plain helper (not a module method) — cache needs no params
+        return TransformerLM(self.config).init_cache(batch_size, max_length)
+
+
+class CausalLMWithILQLHeads(nn.Module):
+    """Trunk LM + ILQL {V, Q, target-Q} heads (parity: modeling_ilql.py:262-442)."""
+
+    config: TransformerConfig
+    two_qs: bool = True
+
+    def setup(self):
+        self.transformer = TransformerLM(self.config)
+        self.ilql_heads = ILQLHeads(self.config, two_qs=self.two_qs)
+
+    def __call__(
+        self,
+        input_ids: jnp.ndarray,
+        attention_mask: Optional[jnp.ndarray] = None,
+        positions: Optional[jnp.ndarray] = None,
+        actions_ixs: Optional[jnp.ndarray] = None,
+        states_ixs: Optional[jnp.ndarray] = None,
+        cache: Optional[KVCache] = None,
+    ):
+        logits, hidden, _, new_cache = self.transformer(
+            input_ids, attention_mask, positions, cache
+        )
+        if states_ixs is not None:
+            states_hs = batched_index_select(hidden, states_ixs)
+            actions_hs = batched_index_select(hidden, actions_ixs)
+        else:
+            states_hs = actions_hs = hidden
+        qs, target_qs, vs = self.ilql_heads(states_hs, actions_hs)
+        return logits, qs, target_qs, vs, new_cache
+
+
+def branch_param_subtree(trunk_params: Dict[str, Any], start_layer: int, config: TransformerConfig) -> Dict[str, Any]:
+    """Extract the frozen reference-branch params: top layers + final norm + output
+    head (+ tied embedding). This is the JAX analogue of the reference's
+    ``deepcopy`` of unfrozen blocks into ``frozen_head`` (modeling_ppo.py:385-410)."""
+    t = unfreeze(trunk_params) if hasattr(trunk_params, "unfreeze") else dict(trunk_params)
+    sub: Dict[str, Any] = {}
+    for i in range(start_layer, config.num_layers):
+        key = f"layers_{i}"
+        if key in t:
+            sub[key] = jax.tree.map(lambda x: x, t[key])
+    if config.final_norm and "ln_f" in t:
+        sub["ln_f"] = jax.tree.map(lambda x: x, t["ln_f"])
+    if config.tie_word_embeddings:
+        sub["embed_tokens"] = jax.tree.map(lambda x: x, t["embed_tokens"])
+    elif "lm_head" in t:
+        sub["lm_head"] = jax.tree.map(lambda x: x, t["lm_head"])
+    return sub
+
+
+def apply_hydra_branch(
+    module: CausalLMWithValueHead,
+    branch_params: Dict[str, Any],
+    branch_hidden: jnp.ndarray,
+    attention_mask: Optional[jnp.ndarray],
+    start_layer: int,
+    positions: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Reference logits from the frozen branch (parity: ``forward_hydra``)."""
+    return module.apply(
+        {"params": {"transformer": branch_params}},
+        branch_hidden,
+        attention_mask,
+        positions,
+        start_layer,
+        method=module.forward_branch,
+    )

@@ -1,0 +1,119 @@
+"""Architecture presets + HF-config conversion for the generic TransformerLM.
+
+Replaces the reference's per-architecture model surgery (`hf_get_*` getters,
+`/root/reference/trlx/utils/modeling.py:13-120`, and the per-arch hydra branches in
+`modeling_ppo.py`): each supported family is a preset of TransformerConfig switches.
+"""
+
+from typing import Any, Dict, Optional
+
+from trlx_tpu.models.transformer import TransformerConfig
+
+# Tiny shape defaults used when no checkpoint is available (offline/random-init runs
+# and tests); real dims come from HF configs via from_hf_config.
+PRESETS: Dict[str, TransformerConfig] = {
+    "gpt2": TransformerConfig(
+        vocab_size=50257, hidden_size=768, num_layers=12, num_heads=12,
+        max_position_embeddings=1024, pos_embedding="learned", norm="layernorm",
+        activation="gelu_new", attn_bias=True, mlp_bias=True, tie_word_embeddings=True,
+    ),
+    "gptj": TransformerConfig(
+        vocab_size=50400, hidden_size=4096, num_layers=28, num_heads=16,
+        max_position_embeddings=2048, pos_embedding="rotary", rope_style="gptj",
+        rotary_pct=64 / 256, norm="layernorm", activation="gelu_new",
+        parallel_residual=True, shared_parallel_ln=True, attn_bias=False, mlp_bias=True,
+        head_bias=True, tie_word_embeddings=False,
+    ),
+    "gpt_neox": TransformerConfig(
+        vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
+        max_position_embeddings=2048, pos_embedding="rotary", rope_style="neox",
+        rotary_pct=0.25, norm="layernorm", activation="gelu", parallel_residual=True,
+        shared_parallel_ln=False, attn_bias=True, mlp_bias=True, tie_word_embeddings=False,
+    ),
+    "opt": TransformerConfig(
+        vocab_size=50272, hidden_size=768, num_layers=12, num_heads=12,
+        max_position_embeddings=2048, pos_embedding="learned", pos_offset=2,
+        norm="layernorm", activation="relu", attn_bias=True, mlp_bias=True,
+        tie_word_embeddings=True,
+    ),
+    "llama": TransformerConfig(
+        vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
+        intermediate_size=11008, max_position_embeddings=4096, pos_embedding="rotary",
+        rope_style="neox", norm="rmsnorm", norm_eps=1e-6, activation="silu", glu=True,
+        attn_bias=False, mlp_bias=False, tie_word_embeddings=False,
+    ),
+}
+
+
+def get_preset(name: str, overrides: Optional[Dict[str, Any]] = None) -> TransformerConfig:
+    """Resolve a family preset by name (exact or prefix: "gpt2-imdb" -> gpt2)."""
+    key = name.lower()
+    config = None
+    if key in PRESETS:
+        config = PRESETS[key]
+    else:
+        for family in ("gpt_neox", "gptj", "gpt2", "llama", "opt"):
+            if family.replace("_", "") in key.replace("_", "").replace("-", ""):
+                config = PRESETS[family]
+                break
+        if config is None and ("pythia" in key or "neox" in key):
+            config = PRESETS["gpt_neox"]
+    if config is None:
+        raise ValueError(f"Unknown architecture preset for {name!r}; known: {sorted(PRESETS)}")
+    if overrides:
+        config = config.replace(**overrides)
+    return config
+
+
+def from_hf_config(hf_config, overrides: Optional[Dict[str, Any]] = None) -> TransformerConfig:
+    """Convert a ``transformers`` config object to TransformerConfig."""
+    mt = hf_config.model_type
+    if mt == "gpt2":
+        config = PRESETS["gpt2"].replace(
+            vocab_size=hf_config.vocab_size, hidden_size=hf_config.n_embd,
+            num_layers=hf_config.n_layer, num_heads=hf_config.n_head,
+            max_position_embeddings=hf_config.n_positions,
+            norm_eps=hf_config.layer_norm_epsilon,
+        )
+    elif mt == "gptj":
+        config = PRESETS["gptj"].replace(
+            vocab_size=hf_config.vocab_size, hidden_size=hf_config.n_embd,
+            num_layers=hf_config.n_layer, num_heads=hf_config.n_head,
+            max_position_embeddings=hf_config.n_positions,
+            rotary_pct=hf_config.rotary_dim / (hf_config.n_embd // hf_config.n_head),
+            norm_eps=hf_config.layer_norm_epsilon,
+        )
+    elif mt == "gpt_neox":
+        config = PRESETS["gpt_neox"].replace(
+            vocab_size=hf_config.vocab_size, hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers, num_heads=hf_config.num_attention_heads,
+            intermediate_size=hf_config.intermediate_size,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            rotary_pct=hf_config.rotary_pct, norm_eps=hf_config.layer_norm_eps,
+            parallel_residual=hf_config.use_parallel_residual,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+        )
+    elif mt == "opt":
+        config = PRESETS["opt"].replace(
+            vocab_size=hf_config.vocab_size, hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers, num_heads=hf_config.num_attention_heads,
+            intermediate_size=hf_config.ffn_dim,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            tie_word_embeddings=hf_config.tie_word_embeddings,
+        )
+    elif mt == "llama":
+        config = PRESETS["llama"].replace(
+            vocab_size=hf_config.vocab_size, hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers, num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+            intermediate_size=hf_config.intermediate_size,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            norm_eps=hf_config.rms_norm_eps,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+        )
+    else:
+        raise ValueError(f"Unsupported HF model_type {mt!r}")
+    if overrides:
+        config = config.replace(**overrides)
+    return config

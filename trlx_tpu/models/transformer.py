@@ -1,0 +1,377 @@
+"""Generic causal decoder LM in Flax covering the reference's model families.
+
+The reference wraps HF torch models and re-implements a *frozen branch forward* per
+architecture (`/root/reference/trlx/models/modeling_ppo.py:502-1637`: GPT/OPT/Bloom/
+Llama/GPTBigCode branches). Here a single configurable module covers gpt2, gpt-neox/
+pythia, gpt-j, opt, and llama: positional scheme (learned/rotary, neox- or gptj-style),
+norm type (LN/RMS), activation (gelu/gelu_new/relu/silu), GLU mlp, parallel residual,
+biases, GQA, and tied embeddings are all config switches. The same block stack is
+reusable as the hydra frozen branch by calling ``forward_from`` on the top-N layers
+with a separate (frozen) param subtree — no per-architecture branch code.
+
+TPU-first details: all matmuls run in ``compute_dtype`` (bf16) against fp32 master
+params; attention uses an additive mask built from fixed shapes (no dynamic shapes);
+the KV cache is an explicit functional pytree updated with ``dynamic_update_slice`` so
+generation jits to a single XLA while-loop; activations can be sequence-sharded via
+``with_sharding_constraint`` hooks (Megatron-SP analogue).
+"""
+
+import math
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+KVCache = Dict[str, Any]  # {"k": [L,B,Hkv,S,D], "v": [L,B,Hkv,S,D], "index": i32[]}
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    """Architecture hyperparameters; presets for each family in
+    :mod:`trlx_tpu.models.presets`."""
+
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None  # GQA; None = num_heads
+    head_dim: Optional[int] = None  # None = hidden_size // num_heads
+    intermediate_size: Optional[int] = None  # None = 4*hidden
+    max_position_embeddings: int = 1024
+
+    pos_embedding: str = "learned"  # "learned" | "rotary" | "none"
+    rope_style: str = "neox"  # "neox" (rotate-half) | "gptj" (interleaved)
+    rotary_pct: float = 1.0
+    rope_theta: float = 10000.0
+    pos_offset: int = 0  # OPT uses a +2 offset into its learned table
+
+    norm: str = "layernorm"  # "layernorm" | "rmsnorm"
+    norm_eps: float = 1e-5
+    activation: str = "gelu_new"  # "gelu_new" | "gelu" | "relu" | "silu"
+    glu: bool = False  # SwiGLU-style gated mlp (llama)
+    parallel_residual: bool = False  # gptj / neox style
+    shared_parallel_ln: bool = False  # gptj: one LN feeds both attn and mlp
+    attn_bias: bool = True
+    mlp_bias: bool = True
+    head_bias: bool = False  # gptj's lm_head carries a bias
+    tie_word_embeddings: bool = True
+    final_norm: bool = True
+
+    initializer_range: float = 0.02
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    remat: str = "none"  # "none" | "full" | "nothing_saveable" | "dots_saveable"
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def dim_per_head(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+    def replace(self, **kw) -> "TransformerConfig":
+        return replace(self, **kw)
+
+
+def _act(name: str):
+    return {
+        "gelu_new": lambda x: jax.nn.gelu(x, approximate=True),
+        "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+        "relu": jax.nn.relu,
+        "silu": jax.nn.silu,
+    }[name]
+
+
+def _norm_module(config: TransformerConfig, name: Optional[str] = None):
+    kw = dict(epsilon=config.norm_eps, dtype=config.compute_dtype, param_dtype=config.param_dtype)
+    if name is not None:
+        kw["name"] = name
+    if config.norm == "rmsnorm":
+        return nn.RMSNorm(**kw)
+    return nn.LayerNorm(**kw)
+
+
+def make_rotary(config: TransformerConfig, positions: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables [B, T, rot_dim/2] for the given positions."""
+    rot_dim = int(config.dim_per_head * config.rotary_pct)
+    rot_dim -= rot_dim % 2
+    inv_freq = 1.0 / (config.rope_theta ** (jnp.arange(0, rot_dim, 2, dtype=jnp.float32) / rot_dim))
+    freqs = positions[..., None].astype(jnp.float32) * inv_freq  # [B,T,rot/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rotary(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray, style: str) -> jnp.ndarray:
+    """Rotate queries/keys. x: [B, T, H, D]; cos/sin [B, T, rot/2]."""
+    rot_dim = cos.shape[-1] * 2
+    x_rot, x_pass = x[..., :rot_dim], x[..., rot_dim:]
+    cos = cos[:, :, None, :]
+    sin = sin[:, :, None, :]
+    if style == "gptj":
+        # interleaved pairs (x0,x1),(x2,x3),...
+        x1 = x_rot[..., 0::2]
+        x2 = x_rot[..., 1::2]
+        r1 = x1 * cos - x2 * sin
+        r2 = x2 * cos + x1 * sin
+        rotated = jnp.stack([r1, r2], axis=-1).reshape(x_rot.shape)
+    else:
+        # neox rotate-half: first half paired with second half
+        half = rot_dim // 2
+        x1 = x_rot[..., :half]
+        x2 = x_rot[..., half:]
+        r1 = x1 * cos - x2 * sin
+        r2 = x2 * cos + x1 * sin
+        rotated = jnp.concatenate([r1, r2], axis=-1)
+    return jnp.concatenate([rotated, x_pass], axis=-1).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        mask_bias: jnp.ndarray,
+        positions: jnp.ndarray,
+        cache: Optional[Dict[str, jnp.ndarray]] = None,
+    ) -> Tuple[jnp.ndarray, Optional[Dict[str, jnp.ndarray]]]:
+        """x: [B,T,Hid]; mask_bias additive [B,1,T,S]; cache holds this layer's k/v
+        [B,S,Hkv,D] plus the global write index."""
+        c = self.config
+        B, T, _ = x.shape
+        dense = lambda feats, name, bias: nn.Dense(
+            feats, use_bias=bias, dtype=c.compute_dtype, param_dtype=c.param_dtype,
+            kernel_init=nn.initializers.normal(c.initializer_range), name=name,
+        )
+        q = dense(c.num_heads * c.dim_per_head, "q_proj", c.attn_bias)(x)
+        k = dense(c.kv_heads * c.dim_per_head, "k_proj", c.attn_bias)(x)
+        v = dense(c.kv_heads * c.dim_per_head, "v_proj", c.attn_bias)(x)
+        q = q.reshape(B, T, c.num_heads, c.dim_per_head)
+        k = k.reshape(B, T, c.kv_heads, c.dim_per_head)
+        v = v.reshape(B, T, c.kv_heads, c.dim_per_head)
+
+        if c.pos_embedding == "rotary":
+            cos, sin = make_rotary(c, positions)
+            q = apply_rotary(q, cos, sin, c.rope_style)
+            k = apply_rotary(k, cos, sin, c.rope_style)
+
+        if cache is not None:
+            idx = cache["index"]
+            k = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, idx, 0, 0))
+            v = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, idx, 0, 0))
+            new_cache = {"k": k, "v": v}
+        else:
+            new_cache = None
+
+        # grouped-query: repeat kv heads
+        if c.kv_heads != c.num_heads:
+            rep = c.num_heads // c.kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+
+        scale = 1.0 / math.sqrt(c.dim_per_head)
+        # [B,H,T,S]
+        scores = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+        scores = scores + mask_bias
+        probs = jax.nn.softmax(scores, axis=-1).astype(c.compute_dtype)
+        out = jnp.einsum("bhts,bshd->bthd", probs, v)
+        out = out.reshape(B, T, c.num_heads * c.dim_per_head)
+        out = dense(c.hidden_size, "o_proj", c.attn_bias)(out)
+        return out, new_cache
+
+
+class MLP(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        c = self.config
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=c.mlp_bias, dtype=c.compute_dtype, param_dtype=c.param_dtype,
+            kernel_init=nn.initializers.normal(c.initializer_range), name=name,
+        )
+        act = _act(c.activation)
+        if c.glu:
+            h = act(dense(c.ffn_dim, "gate_proj")(x)) * dense(c.ffn_dim, "up_proj")(x)
+        else:
+            h = act(dense(c.ffn_dim, "up_proj")(x))
+        return dense(c.hidden_size, "down_proj")(h)
+
+
+class Block(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask_bias, positions, cache=None):
+        c = self.config
+        if c.parallel_residual:
+            h1 = _norm_module(c, "ln_1")(x)
+            h2 = h1 if c.shared_parallel_ln else _norm_module(c, "ln_2")(x)
+            attn_out, new_cache = Attention(c, name="attn")(h1, mask_bias, positions, cache)
+            mlp_out = MLP(c, name="mlp")(h2)
+            return x + attn_out + mlp_out, new_cache
+        attn_out, new_cache = Attention(c, name="attn")(_norm_module(c, "ln_1")(x), mask_bias, positions, cache)
+        x = x + attn_out
+        x = x + MLP(c, name="mlp")(_norm_module(c, "ln_2")(x))
+        return x, new_cache
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM. ``__call__`` returns (logits, final_hidden, branch_hidden,
+    cache); ``forward_from`` re-runs the top layers from a branch activation (hydra)."""
+
+    config: TransformerConfig
+
+    def setup(self):
+        c = self.config
+        self.embed_tokens = nn.Embed(
+            c.vocab_size, c.hidden_size, dtype=c.compute_dtype, param_dtype=c.param_dtype,
+            embedding_init=nn.initializers.normal(c.initializer_range),
+        )
+        if c.pos_embedding == "learned":
+            self.embed_positions = nn.Embed(
+                c.max_position_embeddings + c.pos_offset, c.hidden_size,
+                dtype=c.compute_dtype, param_dtype=c.param_dtype,
+                embedding_init=nn.initializers.normal(c.initializer_range),
+            )
+        block = Block
+        if c.remat != "none":
+            policy = {
+                "full": None,
+                "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+                "dots_saveable": jax.checkpoint_policies.dots_saveable,
+            }[c.remat]
+            block = nn.remat(Block, policy=policy)
+        self.layers = [block(c) for _ in range(c.num_layers)]
+        if c.final_norm:
+            self.ln_f = _norm_module(c)
+        if not c.tie_word_embeddings:
+            self.lm_head = nn.Dense(
+                c.vocab_size, use_bias=c.head_bias, dtype=c.compute_dtype, param_dtype=c.param_dtype,
+                kernel_init=nn.initializers.normal(c.initializer_range),
+            )
+
+    def _final(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """(logits, post-norm hidden)."""
+        if self.config.final_norm:
+            x = self.ln_f(x)
+        if self.config.tie_word_embeddings:
+            emb = self.embed_tokens.embedding.astype(self.config.compute_dtype)
+            logits = x @ emb.T
+        else:
+            logits = self.lm_head(x)
+        return logits, x
+
+    def embed(self, input_ids: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
+        x = self.embed_tokens(input_ids)
+        if self.config.pos_embedding == "learned":
+            x = x + self.embed_positions(positions + self.config.pos_offset)
+        return x
+
+    def __call__(
+        self,
+        input_ids: jnp.ndarray,
+        attention_mask: Optional[jnp.ndarray] = None,
+        positions: Optional[jnp.ndarray] = None,
+        cache: Optional[KVCache] = None,
+        branch_layer: Optional[int] = None,
+    ):
+        """input_ids [B,T]; attention_mask [B,T] (1=real token). With ``cache``,
+        T may be 1 (decode step) and the mask must cover the cache length [B,S].
+        Returns (logits [B,T,V], hidden [B,T,Hid] post-norm, branch_hidden or None,
+        new cache or None). ``branch_layer`` = index of the first *unfrozen* layer;
+        its input activation is returned for the hydra reference branch."""
+        c = self.config
+        B, T = input_ids.shape
+        if cache is not None:
+            S = cache["k"].shape[2]  # [L,B,S,H,D] -> S at axis 2
+            idx = cache["index"]
+            if positions is None:
+                positions = idx + jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+            # Causal structure over cache *slots*: slots are written in temporal
+            # order, so slot index ordering == temporal ordering even with left
+            # padding (where position values repeat under the pad mask).
+            kv_slot = jnp.arange(S)[None, None, None, :]
+            q_slot = (idx + jnp.arange(T, dtype=jnp.int32))[None, None, :, None]
+            causal = kv_slot <= q_slot
+            if attention_mask is not None:
+                causal = jnp.logical_and(causal, attention_mask[:, None, None, :].astype(bool))
+            mask_bias = jnp.where(causal, 0.0, -1e9).astype(jnp.float32)
+        else:
+            if positions is None:
+                if attention_mask is not None:
+                    positions = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0, None).astype(jnp.int32)
+                else:
+                    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+            causal = jnp.tril(jnp.ones((T, T), dtype=bool))[None, None, :, :]
+            if attention_mask is not None:
+                causal = jnp.logical_and(causal, attention_mask[:, None, None, :].astype(bool))
+            mask_bias = jnp.where(causal, 0.0, -1e9).astype(jnp.float32)
+
+        x = self.embed(input_ids, positions)
+        branch_hidden = None
+        new_layer_caches = []
+        for i, layer in enumerate(self.layers):
+            if branch_layer is not None and i == branch_layer:
+                branch_hidden = x
+            layer_cache = None
+            if cache is not None:
+                layer_cache = {"k": cache["k"][i], "v": cache["v"][i], "index": cache["index"]}
+            x, new_lc = layer(x, mask_bias, positions, layer_cache)
+            if cache is not None:
+                new_layer_caches.append(new_lc)
+        logits, hidden = self._final(x)
+        new_cache = None
+        if cache is not None:
+            new_cache = {
+                "k": jnp.stack([lc["k"] for lc in new_layer_caches]),
+                "v": jnp.stack([lc["v"] for lc in new_layer_caches]),
+                "index": cache["index"] + T,
+            }
+        return logits, hidden, branch_hidden, new_cache
+
+    def forward_from(
+        self,
+        hidden: jnp.ndarray,
+        attention_mask: Optional[jnp.ndarray],
+        positions: Optional[jnp.ndarray],
+        start_layer: int,
+    ):
+        """Run layers[start_layer:] + final norm + lm head from a branch activation.
+        This is the hydra frozen-branch forward (reference ``forward_hydra``,
+        modeling_ppo.py:410-453) — called with the frozen param subtree via
+        ``apply({"params": frozen}, ..., method="forward_from")``."""
+        B, T, _ = hidden.shape
+        if positions is None:
+            if attention_mask is not None:
+                positions = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0, None).astype(jnp.int32)
+            else:
+                positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+        causal = jnp.tril(jnp.ones((T, T), dtype=bool))[None, None, :, :]
+        if attention_mask is not None:
+            causal = jnp.logical_and(causal, attention_mask[:, None, None, :].astype(bool))
+        mask_bias = jnp.where(causal, 0.0, -1e9).astype(jnp.float32)
+        x = hidden
+        for layer in self.layers[start_layer:]:
+            x, _ = layer(x, mask_bias, positions, None)
+        logits, _ = self._final(x)
+        return logits
+
+    def init_cache(self, batch_size: int, max_length: int, dtype=None) -> KVCache:
+        c = self.config
+        dtype = dtype or c.compute_dtype
+        shape = (c.num_layers, batch_size, max_length, c.kv_heads, c.dim_per_head)
+        return {
+            "k": jnp.zeros(shape, dtype),
+            "v": jnp.zeros(shape, dtype),
+            "index": jnp.array(0, jnp.int32),
+        }
