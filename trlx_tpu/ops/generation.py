@@ -1,0 +1,157 @@
+"""Jitted KV-cache autoregressive generation under SPMD.
+
+This replaces the reference's reliance on HF ``generate`` / NeMo ``text_generation``
+(SURVEY.md §2.4.8 — "the rollout hot loop"): prefill builds the cache in one forward,
+then a ``lax.while_loop`` decodes one token per step with early exit when every
+sequence has finished (under SPMD the ``finished`` reduction is global, giving the
+pod-wide eos short-circuit the reference gets from ``synced_gpus``). All shapes are
+static: prompts are left-padded to a bucketed length, the cache is preallocated at
+``prompt_len + max_new_tokens``, and the sequence buffer is donated across steps.
+
+ILQL's advantage-shaped decoding (reference ``modeling_ilql.py:325-412``) plugs in as
+a ``logits_processor(params, hidden, logits) -> logits`` hook evaluated on the decode
+hidden state each step.
+"""
+
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.ops.sampling import sample_token
+
+# step_fn(params, ids[B,T], mask[B,S], positions[B,T], cache) -> (logits[B,T,V],
+# hidden[B,T,H], cache). `hidden` feeds the ILQL logit processor; pass None-free.
+StepFn = Callable[..., Tuple[jnp.ndarray, jnp.ndarray, Any]]
+
+
+def pad_to_bucket(length: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= length (limits recompilation across prompt lengths;
+    parity concern: reference pads to multiples of 8, SURVEY.md §7 hard-part 3)."""
+    for b in sorted(buckets):
+        if b >= length:
+            return b
+    return int(np.ceil(length / 64) * 64)
+
+
+def left_pad_batch(
+    ids_list: List[np.ndarray], pad_token_id: int, target_len: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side: left-pad a ragged list of prompt id arrays to [B, target_len]."""
+    B = len(ids_list)
+    out = np.full((B, target_len), pad_token_id, dtype=np.int32)
+    mask = np.zeros((B, target_len), dtype=np.int32)
+    for i, ids in enumerate(ids_list):
+        ids = np.asarray(ids, dtype=np.int32)[-target_len:]
+        out[i, target_len - len(ids):] = ids
+        mask[i, target_len - len(ids):] = 1
+    return out, mask
+
+
+def generate(
+    step_fn: StepFn,
+    params: Any,
+    init_cache_fn: Callable[[int, int], Any],
+    input_ids: jnp.ndarray,
+    attention_mask: jnp.ndarray,
+    rng: jax.Array,
+    max_new_tokens: int,
+    eos_token_id: Optional[int] = None,
+    pad_token_id: int = 0,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    do_sample: bool = True,
+    min_new_tokens: int = 0,
+    logits_processor: Optional[Callable[[Any, jnp.ndarray, jnp.ndarray], jnp.ndarray]] = None,
+) -> Dict[str, jnp.ndarray]:
+    """Generate continuations for left-padded prompts.
+
+    Returns dict with ``sequences`` [B, P+N] (prompt + generation, ``pad_token_id``
+    after eos) and ``response_mask`` [B, N] (1 on generated tokens up to & incl. eos).
+    Fully traceable: wrap in jit with static max_new_tokens via the trainer.
+    """
+    B, P = input_ids.shape
+    N = int(max_new_tokens)
+    total = P + N
+    prompt_lens = attention_mask.sum(axis=1).astype(jnp.int32)
+
+    cache = init_cache_fn(B, total)
+    # mask over all cache slots; generated slots get enabled as they are written
+    full_mask = jnp.concatenate([attention_mask.astype(jnp.int32), jnp.zeros((B, N), jnp.int32)], axis=1)
+
+    positions = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0, None).astype(jnp.int32)
+    logits, hidden, cache = step_fn(params, input_ids, full_mask, positions, cache)
+    last_logits = logits[:, -1, :]
+    if logits_processor is not None:
+        last_logits = logits_processor(params, hidden[:, -1, :], last_logits)
+
+    seqs = jnp.concatenate([input_ids, jnp.full((B, N), pad_token_id, jnp.int32)], axis=1)
+
+    def sample_step(rng, step, logits, finished):
+        rng, sub = jax.random.split(rng)
+        if eos_token_id is not None and min_new_tokens > 0:
+            logits = jnp.where(
+                (step < min_new_tokens) & (jnp.arange(logits.shape[-1]) == eos_token_id)[None, :],
+                -1e9,
+                logits,
+            )
+        tok = sample_token(sub, logits, temperature, top_k, top_p, do_sample)
+        tok = jnp.where(finished, pad_token_id, tok)
+        return rng, tok
+
+    rng, tok = sample_step(rng, jnp.array(0), last_logits, jnp.zeros((B,), bool))
+    finished = jnp.zeros((B,), bool)
+    if eos_token_id is not None:
+        finished = tok == eos_token_id
+
+    def write(state_seqs, state_mask, tok, step):
+        new_seqs = jax.lax.dynamic_update_slice(state_seqs, tok[:, None], (0, P + step))
+        new_mask = jax.lax.dynamic_update_slice(
+            state_mask, jnp.ones((B, 1), jnp.int32), (0, P + step)
+        )
+        return new_seqs, new_mask
+
+    seqs, full_mask = write(seqs, full_mask, tok, 0)
+
+    def cond(state):
+        step, _, _, finished, _, _, _ = state
+        return jnp.logical_and(step < N, jnp.logical_not(jnp.all(finished)))
+
+    def body(state):
+        step, seqs, full_mask, finished, cache, rng, tok = state
+        # `tok` was sampled at iteration step-1 and sits at sequence slot P+step-1,
+        # i.e. per-sample position prompt_len + step - 1
+        pos = (prompt_lens + step - 1)[:, None]
+        logits, hidden, cache = step_fn(params, tok[:, None], full_mask, pos, cache)
+        step_logits = logits[:, -1, :]
+        if logits_processor is not None:
+            step_logits = logits_processor(params, hidden[:, -1, :], step_logits)
+        rng, new_tok = sample_step(rng, step, step_logits, finished)
+        new_finished = finished
+        if eos_token_id is not None:
+            new_finished = jnp.logical_or(finished, new_tok == eos_token_id)
+        seqs, full_mask = write(seqs, full_mask, new_tok, step)
+        return step + 1, seqs, full_mask, new_finished, cache, rng, new_tok
+
+    state = (jnp.array(1, jnp.int32), seqs, full_mask, finished, cache, rng, tok)
+    step, seqs, full_mask, finished, cache, rng, tok = jax.lax.while_loop(cond, body, state)
+
+    response_mask = full_mask[:, P:]
+    # zero out mask past each sample's eos is already handled: finished samples write
+    # pad tokens but their mask slots were set; rebuild mask from tokens instead:
+    if eos_token_id is not None:
+        resp = seqs[:, P:]
+        is_eos = resp == eos_token_id
+        after_eos = jnp.cumsum(jnp.pad(is_eos[:, :-1], ((0, 0), (1, 0))), axis=1) > 0
+        response_mask = response_mask * (1 - after_eos.astype(jnp.int32))
+        # never count trailing never-written slots (loop exited early)
+        written = jnp.arange(N)[None, :] < step
+        response_mask = response_mask * written.astype(jnp.int32)
+        seqs = jnp.concatenate(
+            [seqs[:, :P], jnp.where(response_mask > 0, resp, pad_token_id)], axis=1
+        )
+    return {"sequences": seqs, "response_mask": response_mask}
