@@ -1,0 +1,132 @@
+"""Pipeline tests: dialogue tokenization truncation semantics (parity with reference
+tests/test_pipelines.py), prompt pipeline metadata, PPO collate, minibatch slicing."""
+
+import numpy as np
+import pytest
+
+from trlx_tpu.data.ilql_types import ILQLBatch, flatten_dataclass, unflatten_dataclass
+from trlx_tpu.data.ppo_types import PPORLElement
+from trlx_tpu.pipeline import MiniBatchIterator, PromptPipeline
+from trlx_tpu.pipeline.offline_pipeline import DialogStore, tokenize_dialogue
+from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage, ppo_collate_fn
+from trlx_tpu.pipeline.tokenization import CharTokenizer
+
+
+@pytest.fixture
+def tok():
+    return CharTokenizer("abcdefgh ", padding_side="left", truncation_side="right")
+
+
+def test_tokenize_dialogue_single_string(tok):
+    msgs = tokenize_dialogue("abc", tok)
+    # bos prompt + output ending in eos
+    assert msgs[0].is_output is False
+    assert msgs[-1].is_output is True
+    assert msgs[-1].tokens[-1] == tok.eos_token_id
+
+
+def test_tokenize_dialogue_multi_turn(tok):
+    msgs = tokenize_dialogue(["ab", "cd", "ef", "gh"], tok)
+    assert [m.is_output for m in msgs] == [False, True, False, True]
+    assert msgs[-1].tokens[-1] == tok.eos_token_id
+
+
+def test_tokenize_dialogue_right_truncation(tok):
+    msgs = tokenize_dialogue(["abcd", "efgh"], tok, max_length=6)
+    total = sum(len(m.tokens) for m in msgs)
+    assert total <= 6
+    # right truncation keeps the left side (prompt intact)
+    assert msgs[0].tokens == tuple(tok.encode("abcd"))
+
+
+def test_tokenize_dialogue_left_truncation():
+    tok = CharTokenizer("abcdefgh ", truncation_side="left")
+    msgs = tokenize_dialogue(["abcd", "efgh"], tok, max_length=6)
+    total = sum(len(m.tokens) for m in msgs)
+    assert total <= 6
+    # left truncation keeps the right side (output + eos intact)
+    assert msgs[-1].tokens[-1] == tok.eos_token_id
+    # fully-truncated leading prompt is replaced by bos
+    assert msgs[0].is_output is False
+
+
+def test_prompt_pipeline_metadata(tok):
+    prompts = [{"prompt": "abc", "label": 1}, {"prompt": "de", "label": 0}]
+    pipe = PromptPipeline(prompts, max_prompt_length=8, tokenizer=tok)
+    loader = pipe.create_loader(batch_size=2)
+    batch = next(iter(loader))
+    assert [len(x) for x in batch["input_ids"]] == [3, 2]
+    assert batch["label"] == [1, 0]
+
+
+def test_prompt_pipeline_truncates(tok):
+    pipe = PromptPipeline(["abcdefgh"], max_prompt_length=4, tokenizer=tok)
+    assert len(pipe[0]["input_ids"]) == 4
+    # left truncation side keeps the tail
+    tok_l = CharTokenizer("abcdefgh ", truncation_side="left")
+    pipe_l = PromptPipeline(["abcdefgh"], max_prompt_length=4, tokenizer=tok_l)
+    assert pipe_l[0]["input_ids"] == tok_l.encode("efgh")
+
+
+def test_dialog_store_masks_prompt(tok):
+    dialogs = [tokenize_dialogue(["ab", "cd"], tok)]
+    store = DialogStore(dialogs, tok)
+    batch = next(iter(store.create_loader(1)))
+    labels = batch["labels"][0]
+    ids = batch["input_ids"][0]
+    n_prompt = len(tok.encode("ab"))
+    assert (labels[:n_prompt] == -100).all()
+    assert (labels[n_prompt:] == ids[n_prompt:]).all()
+
+
+def test_ppo_collate_padding():
+    e1 = PPORLElement(
+        np.array([1, 2, 3]), np.array([4, 5]), np.array([0.1, 0.2]),
+        np.array([1.0, 2.0]), np.array([0.0, 1.0]),
+    )
+    e2 = PPORLElement(
+        np.array([7]), np.array([8, 9, 10]), np.array([0.3, 0.4, 0.5]),
+        np.array([3.0, 4.0, 5.0]), np.array([0.0, 0.0, 2.0]),
+    )
+    batch = ppo_collate_fn(0, [e1, e2])
+    # queries left-padded
+    assert batch.query_tensors.tolist() == [[1, 2, 3], [0, 0, 7]]
+    assert batch.attention_mask.tolist() == [[1, 1, 1], [0, 0, 1]]
+    # responses right-padded
+    assert batch.response_tensors.tolist() == [[4, 5, 0], [8, 9, 10]]
+    assert batch.response_mask.tolist() == [[1, 1, 0], [1, 1, 1]]
+    assert batch.rewards[0].tolist() == [0.0, 1.0, 0.0]
+
+
+def test_ppo_storage_loader_and_minibatch():
+    store = PPORolloutStorage(pad_token_id=0)
+    elems = [
+        PPORLElement(
+            np.arange(1, 4), np.arange(4, 7), np.ones(3), np.ones(3), np.ones(3)
+        )
+        for _ in range(8)
+    ]
+    store.push(elems)
+    assert len(store) == 8
+    loader = store.create_loader(batch_size=4, shuffle=True)
+    mbs = next(iter(MiniBatchIterator(loader, mb_size=2, num_mb=2)))
+    assert len(mbs) == 2
+    assert mbs[0].query_tensors.shape == (2, 3)
+
+
+def test_flatten_unflatten_dataclass():
+    batch = ILQLBatch(
+        np.ones((2, 3)), np.ones((2, 3)), np.ones((2, 2)),
+        np.ones((2, 3)), np.ones((2, 2)), np.ones((2, 3)),
+    )
+    leaves = flatten_dataclass(ILQLBatch)(batch)
+    assert len(leaves) == 6
+    rebuilt = unflatten_dataclass(ILQLBatch)(leaves)
+    assert np.allclose(rebuilt.rewards, batch.rewards)
+
+
+def test_char_tokenizer_roundtrip(tok):
+    ids = tok.encode("abc de")
+    assert tok.decode(ids) == "abc de"
+    assert tok.decode([tok.eos_token_id] + ids) == "abc de"
+    assert tok.decode([tok.eos_token_id], skip_special_tokens=False) == "<eos>"

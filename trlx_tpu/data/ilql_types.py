@@ -1,0 +1,71 @@
+"""ILQL datatypes (parity: `/root/reference/trlx/data/ilql_types.py:7-139`), plus the
+``flatten_dataclass``/``unflatten_dataclass`` helpers the reference *intends* to have
+(they are imported by its NeMo trainers but missing from the snapshot — SURVEY.md §2.1
+"Known snapshot defect"). With pytrees they are one-liners."""
+
+from typing import Any, List, Tuple
+
+import flax.struct
+import jax
+
+
+@flax.struct.dataclass
+class ILQLElement:
+    input_ids: Any
+    attention_mask: Any
+    rewards: Any
+    states_ixs: Any
+    actions_ixs: Any
+    dones: Any
+
+
+@flax.struct.dataclass
+class ILQLBatch:
+    input_ids: Any  # [B, T]
+    attention_mask: Any  # [B, T]
+    rewards: Any  # [B, A]
+    states_ixs: Any  # [B, A+1]
+    actions_ixs: Any  # [B, A]
+    dones: Any  # [B, A+1]
+
+
+@flax.struct.dataclass
+class ILQLSeq2SeqElement:
+    input_ids: Any
+    attention_mask: Any
+    decoder_input_ids: Any
+    rewards: Any
+    states_ixs: Any
+    actions_ixs: Any
+    dones: Any
+
+
+@flax.struct.dataclass
+class ILQLSeq2SeqBatch:
+    input_ids: Any
+    attention_mask: Any
+    decoder_input_ids: Any
+    rewards: Any
+    states_ixs: Any
+    actions_ixs: Any
+    dones: Any
+
+
+def flatten_dataclass(cls: type):
+    """Return fn: instance -> flat list of leaves (tensor-list transport, cf. the
+    reference's missing helper used at `modeling_nemo_ppo.py:949`)."""
+
+    def flatten(instance) -> List[Any]:
+        return jax.tree.leaves(instance)
+
+    return flatten
+
+
+def unflatten_dataclass(cls: type):
+    """Return fn: flat leaves -> instance of the flax.struct dataclass."""
+
+    def unflatten(leaves: List[Any]):
+        treedef = jax.tree.structure(cls(*([0] * len(cls.__dataclass_fields__))))
+        return jax.tree.unflatten(treedef, leaves)
+
+    return unflatten

@@ -1,0 +1,32 @@
+"""PPO rollout datatypes (parity: `/root/reference/trlx/data/ppo_types.py:7-63`),
+as flax.struct pytrees so batches flow through jit/pjit directly."""
+
+from typing import Any
+
+import flax.struct
+import numpy as np
+
+
+@flax.struct.dataclass
+class PPORLElement:
+    """One rollout: query (prompt) tokens, response tokens, and per-response-token
+    logprobs / values / rewards (KL-penalized, score at last token)."""
+
+    query_tensor: Any  # [P]
+    response_tensor: Any  # [R]
+    logprobs: Any  # [R]
+    values: Any  # [R]
+    rewards: Any  # [R]
+
+
+@flax.struct.dataclass
+class PPORLBatch:
+    """Collated rollouts: queries left-padded, responses right-padded."""
+
+    query_tensors: Any  # [B, P]
+    response_tensors: Any  # [B, R]
+    logprobs: Any  # [B, R]
+    values: Any  # [B, R]
+    rewards: Any  # [B, R]
+    attention_mask: Any  # [B, P] mask for queries
+    response_mask: Any  # [B, R] mask for responses

@@ -1,0 +1,177 @@
+"""Pipeline layer: prompt/rollout dataset abstractions, a torch-free loader, the
+gradient-accumulation minibatch slicer, and the pipeline registry.
+
+Parity: `/root/reference/trlx/pipeline/__init__.py:14-177` (``BasePipeline``,
+``BaseRolloutStore``, ``register_datapipeline``, ``MiniBatchIterator``). The torch
+``DataLoader`` is replaced by :class:`NumpyLoader` — rollout data lives in host numpy
+and is placed onto the device mesh by the trainer (``parallel.mesh.put_batch``), so no
+framework tensor layer is needed in between.
+"""
+
+import random
+from abc import abstractmethod
+from dataclasses import is_dataclass
+from typing import Any, Callable, Dict, Iterable, List
+
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+# name (lowercased) -> pipeline class
+_DATAPIPELINES: Dict[str, type] = {}
+
+
+def register_datapipeline(name_or_cls=None):
+    """Decorator registering a pipeline class by (lowercased) name."""
+
+    def _register(cls, name=None):
+        _DATAPIPELINES[(name or cls.__name__).lower()] = cls
+        return cls
+
+    if isinstance(name_or_cls, str):
+        return lambda cls: _register(cls, name_or_cls)
+    if name_or_cls is None:
+        return _register
+    return _register(name_or_cls)
+
+
+class NumpyLoader:
+    """Minimal re-iterable loader: dataset (sequence) → collated batches.
+
+    ``drop_last`` mirrors the reference's distributed drop_last; under the
+    single-controller SPMD runtime uneven final batches are simply dropped when
+    requested by trainers that need static shapes.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        collate_fn: Callable[[List[Any]], Any],
+        shuffle: bool = False,
+        drop_last: bool = False,
+        seed: int = 0,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._epoch = 0
+        self.seed = seed
+
+    def __len__(self):
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self):
+        idxs = list(range(len(self.dataset)))
+        if self.shuffle:
+            rng = random.Random(self.seed + self._epoch)
+            rng.shuffle(idxs)
+        self._epoch += 1
+        for start in range(0, len(idxs), self.batch_size):
+            chunk = idxs[start : start + self.batch_size]
+            if self.drop_last and len(chunk) < self.batch_size:
+                return
+            yield self.collate_fn([self.dataset[i] for i in chunk])
+
+
+class BasePipeline:
+    """Abstract prompt dataset (parity: pipeline/__init__.py:41-70)."""
+
+    def __init__(self, path: str = "dataset"):
+        self.path = path
+
+    @abstractmethod
+    def __getitem__(self, index: int):
+        ...
+
+    @abstractmethod
+    def __len__(self) -> int:
+        ...
+
+    @abstractmethod
+    def create_loader(self, batch_size: int, shuffle: bool = False) -> NumpyLoader:
+        ...
+
+
+class BaseRolloutStore:
+    """Abstract rollout/experience store (parity: pipeline/__init__.py:73-102)."""
+
+    def __init__(self, capacity: int = -1):
+        self.history: Iterable[Any] = None
+        self.capacity = capacity
+
+    @abstractmethod
+    def push(self, exps: Iterable[Any]):
+        ...
+
+    @abstractmethod
+    def __getitem__(self, index: int):
+        ...
+
+    def __len__(self) -> int:
+        return len(self.history)
+
+    @abstractmethod
+    def create_loader(self, batch_size: int, shuffle: bool = False) -> NumpyLoader:
+        ...
+
+
+class MiniBatchIterator:
+    """Slice loader batches into gradient-accumulation microbatches
+    (parity: pipeline/__init__.py:105-177 incl. the warning semantics)."""
+
+    def __init__(self, data_loader, mb_size: int, num_mb: int):
+        self.data_loader = data_loader
+        self.data_loader_iter = iter(data_loader)
+        self.mb_size = mb_size
+        self.num_mb = num_mb
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        batch = next(self.data_loader_iter)
+        if batch is None:
+            logger.warning("Not enough samples to saturate the minibatch size.")
+            raise StopIteration
+
+        minibatches = []
+        for mbi in range(self.num_mb):
+            batch_dict = batch.__dict__ if is_dataclass(batch) else dict(batch)
+            sliced_data = {}
+            empty = False
+            for key, value in batch_dict.items():
+                sliced = value[mbi * self.mb_size : (mbi + 1) * self.mb_size]
+                if self.num_mb > 1 and len(sliced) == 0:
+                    logger.warning("MiniBatchIterator generated an empty minibatch.")
+                    empty = True
+                    break
+                if self.num_mb > 1 and len(sliced) < self.mb_size:
+                    logger.warning("MiniBatchIterator generated a minibatch smaller than mb_size.")
+                sliced_data[key] = sliced
+            if empty or not sliced_data:
+                break
+            if is_dataclass(batch):
+                minibatches.append(batch.__class__(**sliced_data))
+            else:
+                minibatches.append(sliced_data)
+
+        if not minibatches:
+            raise StopIteration
+        return minibatches
+
+
+from trlx_tpu.pipeline.offline_pipeline import (  # noqa: E402,F401
+    DialogMessage,
+    DialogStore,
+    ILQLRolloutStorage,
+    ILQLSeq2SeqRolloutStorage,
+    PromptPipeline,
+    tokenize_dialogue,
+)
+from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage  # noqa: E402,F401

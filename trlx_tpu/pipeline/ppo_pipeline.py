@@ -1,0 +1,92 @@
+"""PPO rollout storage (parity: `/root/reference/trlx/pipeline/ppo_pipeline.py:14-104`):
+replay buffer of :class:`PPORLElement`, left-pad-query / right-pad-response collate
+into :class:`PPORLBatch`, and JSON export for algorithm distillation."""
+
+import json
+import os
+import time
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from trlx_tpu.data.ppo_types import PPORLBatch, PPORLElement
+from trlx_tpu.pipeline import BaseRolloutStore, NumpyLoader
+
+
+def ppo_collate_fn(pad_token_id: int, elems: List[PPORLElement]) -> PPORLBatch:
+    P = max(len(e.query_tensor) for e in elems)
+    R = max(len(e.response_tensor) for e in elems)
+    B = len(elems)
+
+    queries = np.full((B, P), pad_token_id, np.int32)
+    q_mask = np.zeros((B, P), np.int32)
+    responses = np.full((B, R), pad_token_id, np.int32)
+    r_mask = np.zeros((B, R), np.int32)
+    logprobs = np.zeros((B, R), np.float32)
+    values = np.zeros((B, R), np.float32)
+    rewards = np.zeros((B, R), np.float32)
+
+    for i, e in enumerate(elems):
+        q = np.asarray(e.query_tensor, np.int32)
+        r = np.asarray(e.response_tensor, np.int32)
+        queries[i, P - len(q):] = q  # left-pad queries (parity: ppo_pipeline.py:23-35)
+        q_mask[i, P - len(q):] = 1
+        responses[i, : len(r)] = r
+        r_mask[i, : len(r)] = 1
+        logprobs[i, : len(r)] = np.asarray(e.logprobs, np.float32)[: len(r)]
+        values[i, : len(r)] = np.asarray(e.values, np.float32)[: len(r)]
+        rewards[i, : len(r)] = np.asarray(e.rewards, np.float32)[: len(r)]
+
+    return PPORLBatch(queries, responses, logprobs, values, rewards, q_mask, r_mask)
+
+
+class PPORolloutStorage(BaseRolloutStore):
+    """Rollout storage for PPO experience."""
+
+    def __init__(self, pad_token_id: int):
+        super().__init__()
+        self.pad_token_id = pad_token_id
+        self.history: List[PPORLElement] = []
+
+    def push(self, exps: Iterable[PPORLElement]):
+        self.history += list(exps)
+
+    def clear_history(self):
+        self.history = []
+
+    def export_history(self, location: str, only_text: bool = False, tokenizer=None):
+        """Append rollouts as JSON for algorithm distillation
+        (parity: ppo_pipeline.py:71-89)."""
+        assert os.path.exists(location)
+        fpath = os.path.join(location, f"epoch-{str(time.time())}.json")
+
+        def exp_to_dict(exp: PPORLElement):
+            d = {
+                "query_tensor": np.asarray(exp.query_tensor).tolist(),
+                "response_tensor": np.asarray(exp.response_tensor).tolist(),
+                "logprobs": np.asarray(exp.logprobs).tolist(),
+                "values": np.asarray(exp.values).tolist(),
+                "rewards": np.asarray(exp.rewards).tolist(),
+            }
+            if tokenizer is not None:
+                d["query_text"] = tokenizer.decode(exp.query_tensor)
+                d["response_text"] = tokenizer.decode(exp.response_tensor)
+                if only_text:
+                    d = {"query_text": d["query_text"], "response_text": d["response_text"]}
+            return d
+
+        data = [exp_to_dict(exp) for exp in self.history]
+        with open(fpath, "w") as f:
+            json.dump(data, f)
+
+    def __getitem__(self, index: int) -> PPORLElement:
+        return self.history[index]
+
+    def __len__(self) -> int:
+        return len(self.history)
+
+    def create_loader(self, batch_size: int, shuffle: bool = False, drop_last: bool = True, seed: int = 0) -> NumpyLoader:
+        return NumpyLoader(
+            self, batch_size, lambda elems: ppo_collate_fn(self.pad_token_id, elems),
+            shuffle=shuffle, drop_last=drop_last, seed=seed,
+        )
