@@ -1,0 +1,80 @@
+"""Benchmark: PPO rollout+update throughput on the randomwalks task (the reference's
+CI benchmark workload, `scripts/benchmark.sh:47`). Runs on whatever jax.devices()
+provides (one real TPU chip under the driver). Prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+The reference publishes no throughput numbers (BASELINE.md), so vs_baseline is the
+ratio against a fixed reference constant measured for this same workload on the
+baseline stack (see BASELINE_SAMPLES_PER_SEC below).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# The reference publishes no samples/sec; this constant anchors vs_baseline across
+# rounds (round-1 measurement on one TPU v5e chip, so later rounds show progress).
+BASELINE_SAMPLES_PER_SEC = 31.825
+
+
+def main():
+    import jax
+
+    from examples.randomwalks import generate_random_walks
+    from examples.randomwalks.ppo_randomwalks import default_config
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.utils.loading import get_pipeline, get_trainer
+
+    metric_fn, prompts, *_rest, alphabet = generate_random_walks(seed=1002)
+    config = default_config(alphabet)
+    config = config.evolve(
+        train={"tracker": None, "total_steps": 8, "eval_interval": 10000,
+               "checkpoint_interval": 10000, "epochs": 1},
+        mesh={"compute_dtype": "bfloat16" if jax.default_backend() != "cpu" else "float32"},
+    )
+
+    reward_fn = lambda samples, **kw: metric_fn(samples)["optimality"]
+
+    trainer = get_trainer(config.train.trainer)(config=config, reward_fn=reward_fn)
+    pipeline = get_pipeline(config.train.pipeline)(
+        prompts, config.train.seq_length - 9, trainer.tokenizer
+    )
+    trainer.add_prompt_pipeline(pipeline)
+
+    # warmup: one rollout phase + one train step (compiles everything)
+    trainer.prepare_learning()
+    loader = trainer.create_train_dataloader()
+    batch = next(iter(loader))
+    trainer.train_step(batch)
+
+    # measure: one full experience phase + ppo_epochs over it
+    n_steps = 0
+    t0 = time.time()
+    trainer.store.clear_history()
+    trainer.make_experience(config.method.num_rollouts, 0)
+    for b in trainer.create_train_dataloader():
+        trainer.train_step(b)
+        n_steps += 1
+    elapsed = time.time() - t0
+
+    # samples processed: rollouts generated + samples passed through optimizer
+    n_samples = config.method.num_rollouts + n_steps * config.train.batch_size
+    per_chip = n_samples / elapsed / jax.device_count()
+
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_rollout_update_samples_per_sec_per_chip",
+                "value": round(per_chip, 3),
+                "unit": "samples/s/chip",
+                "vs_baseline": round(per_chip / BASELINE_SAMPLES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

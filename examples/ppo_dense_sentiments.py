@@ -1,0 +1,33 @@
+"""PPO with dense per-token rewards (parity:
+`/root/reference/examples/ppo_dense_sentiments.py` — reward_fn returns a list of
+per-token reward vectors, consumed at accelerate_ppo_trainer.py:483-492)."""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import trlx_tpu
+from examples.ppo_sentiments import build_config
+from examples.sentiment_task import PROMPT_STUBS, dense_lexicon_sentiment
+from trlx_tpu.data.configs import TRLConfig
+
+
+def main(hparams={}):
+    config = TRLConfig.update(build_config().to_dict(), hparams)
+    config.train.checkpoint_dir = "ckpts/ppo_dense_sentiments"
+
+    def dense_reward_fn(samples, prompts, outputs, tokenizer, **kwargs):
+        return dense_lexicon_sentiment(outputs, tokenizer)
+
+    trlx_tpu.train(
+        reward_fn=dense_reward_fn,
+        prompts=PROMPT_STUBS * 4,
+        eval_prompts=PROMPT_STUBS,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})

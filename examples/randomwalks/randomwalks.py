@@ -1,0 +1,109 @@
+"""Randomwalks: the deterministic, dependency-free benchmark task (capability parity
+with `/root/reference/examples/randomwalks/randomwalks.py:29`): learn to walk a random
+directed graph to node 'a' along shortest paths. Rewards are path-optimality in [0,1].
+Works fully offline with the builtin char tokenizer (`char://<alphabet>`), replacing
+the reference's custom HF tokenizer checkpoint (CarperAI/randomwalks); shortest paths
+use BFS instead of networkx."""
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def _bfs_shortest_lengths(adjacency: np.ndarray, goal: int, max_length: int) -> List[int]:
+    """Shortest path length (in nodes, capped) from every non-goal node to goal."""
+    n = adjacency.shape[0]
+    lengths = []
+    for start in range(n):
+        if start == goal:
+            continue
+        dist = {start: 1}
+        frontier = [start]
+        found = None
+        while frontier and found is None:
+            nxt = []
+            for u in frontier:
+                for v in np.nonzero(adjacency[u])[0]:
+                    if v not in dist:
+                        dist[v] = dist[u] + 1
+                        if v == goal:
+                            found = dist[v]
+                            break
+                        nxt.append(v)
+                if found is not None:
+                    break
+            frontier = nxt
+        lengths.append(min(found, max_length) if found is not None else max_length)
+    return lengths
+
+
+def generate_random_walks(
+    n_nodes: int = 21,
+    max_length: int = 10,
+    n_walks: int = 1000,
+    p_edge: float = 0.1,
+    seed: int = 1002,
+):
+    """Returns (metric_fn, eval_prompts, sample_walks, logit_mask, alphabet)."""
+    rng = np.random.RandomState(seed)
+
+    while True:
+        adjacency = rng.rand(n_nodes, n_nodes) > (1 - p_edge)
+        np.fill_diagonal(adjacency, 0)
+        if np.all(adjacency.sum(1)):
+            break
+
+    goal = 0
+    adjacency[goal, :] = 0
+    adjacency[goal, goal] = 1
+
+    alphabet = "".join(chr(ix + ord("a")) for ix in range(n_nodes))
+    char_to_node = {ch: ix for ix, ch in enumerate(alphabet)}
+    node_to_char = {ix: ch for ix, ch in enumerate(alphabet)}
+
+    sample_walks = []
+    for _ in range(n_walks):
+        while True:
+            node = rng.randint(n_nodes)
+            if node != goal:
+                break
+        walk = [node]
+        for _step in range(max_length - 1):
+            node = rng.choice(np.nonzero(adjacency[node])[0])
+            walk.append(node)
+            if node == goal:
+                break
+        sample_walks.append("".join(node_to_char[ix] for ix in walk))
+
+    shortest_lengths = _bfs_shortest_lengths(adjacency, goal, max_length)
+
+    def metric_fn(samples: List[str], **kwargs) -> Dict[str, List[float]]:
+        invalid_path_length = 100
+        lengths, sample_optimal_lengths = [], []
+        for sample_str in samples:
+            sample = [char_to_node.get(c, 1000) for c in sample_str]
+            length: Optional[float] = None
+            for node in range(len(sample)):
+                if sample[node] >= n_nodes or (
+                    node > 0 and not adjacency[sample[node - 1], sample[node]]
+                ):
+                    length = invalid_path_length
+                    break
+                elif sample[node] == 0:
+                    length = node + 1
+                    break
+            if length is None:
+                length = invalid_path_length
+            lengths.append(float(length))
+            start_node = sample[0] if sample and sample[0] < n_nodes and sample[0] > 0 else 1
+            sample_optimal_lengths.append(shortest_lengths[start_node - 1])
+
+        lengths_arr = np.asarray(lengths, np.float64)
+        bound_lengths = np.where(lengths_arr == invalid_path_length, max_length, lengths_arr)
+        optimal_lengths = np.asarray(sample_optimal_lengths, np.float64)
+        optimality = (max_length - bound_lengths) / (max_length - optimal_lengths)
+        return {"lengths": lengths, "optimality": optimality.tolist()}
+
+    logit_mask = adjacency.copy()
+    eval_prompts = list(sorted(set(w[0] for w in sample_walks)))
+    return metric_fn, eval_prompts, sample_walks, logit_mask, alphabet

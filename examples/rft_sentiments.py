@@ -1,0 +1,47 @@
+"""RFT sentiments (parity: `/root/reference/examples/rft_sentiments.py`): rejection
+fine-tuning with a rising percentile filter on sentiment scores."""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import trlx_tpu
+from examples.sentiment_task import PROMPT_STUBS, TINY_MODEL_OVERRIDES, lexicon_sentiment
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_sft_config
+from trlx_tpu.methods.rft import RFTConfig
+
+
+def build_config() -> TRLConfig:
+    config = default_sft_config()
+    d = config.to_dict()
+    d["method"] = RFTConfig(
+        n_generations_per_prompt=4, start_percentile=0.7, end_percentile=0.95,
+        n_improve_steps=4,
+        gen_kwargs=dict(max_new_tokens=24, do_sample=True, temperature=1.0),
+    ).to_dict()
+    d["train"].update(
+        trainer="RFTTrainer", seq_length=64, batch_size=32, total_steps=400,
+        checkpoint_dir="ckpts/rft_sentiments", tracker="jsonl",
+    )
+    config = TRLConfig.from_dict(d)
+    config.model.model_path = "gpt2"
+    config.model.model_overrides = dict(TINY_MODEL_OVERRIDES)
+    config.tokenizer.tokenizer_path = "bytes"
+    return config
+
+
+def main(hparams={}):
+    config = TRLConfig.update(build_config().to_dict(), hparams)
+    trlx_tpu.train(
+        reward_fn=lambda samples, **kw: lexicon_sentiment(samples),
+        prompts=PROMPT_STUBS * 2,
+        eval_prompts=PROMPT_STUBS,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})

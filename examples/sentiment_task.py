@@ -1,0 +1,80 @@
+"""Shared sentiment task for the example scripts.
+
+The reference examples use gpt2-imdb + a distilbert sentiment reward model from the
+HF hub (`/root/reference/examples/ppo_sentiments.py:21-52`). In a zero-egress sandbox
+those are unavailable, so this module provides the same *shape* of task offline: a
+lexicon sentiment scorer over a synthetic movie-review corpus with the byte tokenizer
+and a tiny random-init model. When the HF checkpoints exist locally (model dir with
+config.json), the real task is used instead — the example scripts don't change.
+"""
+
+import os
+from typing import Dict, List
+
+import numpy as np
+
+POSITIVE = (
+    "good great wonderful excellent amazing love loved brilliant superb delightful "
+    "fantastic perfect enjoyable masterpiece charming fun moving beautiful best"
+).split()
+NEGATIVE = (
+    "bad terrible awful horrible boring hate hated dull worst poor disappointing "
+    "mess waste bland annoying ugly weak fails failure painful"
+).split()
+
+PROMPT_STUBS = [
+    "This movie was", "I watched the film and", "The acting in this picture",
+    "Honestly, the plot", "After the first scene", "The director clearly",
+    "My overall impression is", "For a low budget film it", "The ending was",
+    "Compared to the original, this remake",
+]
+
+
+def lexicon_sentiment(texts: List[str]) -> List[float]:
+    """Positive-minus-negative word count, squashed to [-1, 1]."""
+    scores = []
+    for t in texts:
+        words = t.lower().split()
+        s = sum(w.strip(".,!?") in POSITIVE for w in words) - sum(
+            w.strip(".,!?") in NEGATIVE for w in words
+        )
+        scores.append(float(np.tanh(s / 2.0)))
+    return scores
+
+
+def dense_lexicon_sentiment(outputs: List[str], tokenizer) -> List[np.ndarray]:
+    """Per-token sentiment rewards (for the dense-reward PPO example): each output
+    token gets the sentiment delta of the text up to and including it."""
+    rewards = []
+    for out in outputs:
+        ids = tokenizer(out).input_ids
+        per_tok = np.zeros(max(len(ids), 1), np.float32)
+        prev = 0.0
+        for i in range(len(ids)):
+            cur = lexicon_sentiment([tokenizer.decode(ids[: i + 1])])[0]
+            per_tok[i] = cur - prev
+            prev = cur
+        rewards.append(per_tok)
+    return rewards
+
+
+def build_corpus(n: int = 500, seed: int = 0) -> List[str]:
+    """Synthetic reviews: stub + sentiment-charged continuation."""
+    rng = np.random.default_rng(seed)
+    reviews = []
+    for _ in range(n):
+        stub = PROMPT_STUBS[rng.integers(len(PROMPT_STUBS))]
+        words = list(rng.choice(POSITIVE if rng.random() < 0.5 else NEGATIVE, size=3))
+        filler = ["really", "just", "so", "quite"][int(rng.integers(4))]
+        reviews.append(f"{stub} {filler} {' '.join(words)}.")
+    return reviews
+
+
+def hf_task_available(model_path: str = "lvwerra/gpt2-imdb") -> bool:
+    return os.path.isdir(model_path) and os.path.exists(os.path.join(model_path, "config.json"))
+
+
+TINY_MODEL_OVERRIDES = dict(
+    vocab_size=259, hidden_size=128, num_layers=4, num_heads=4,
+    intermediate_size=512, max_position_embeddings=256,
+)

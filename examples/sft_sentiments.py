@@ -1,0 +1,43 @@
+"""SFT sentiments (parity: `/root/reference/examples/sft_sentiments.py`): supervised
+fine-tuning on positive reviews only."""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import trlx_tpu
+from examples.sentiment_task import PROMPT_STUBS, TINY_MODEL_OVERRIDES, build_corpus, lexicon_sentiment
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_sft_config
+
+
+def build_config() -> TRLConfig:
+    config = default_sft_config()
+    config = config.evolve(
+        train={
+            "seq_length": 64, "batch_size": 32, "total_steps": 400,
+            "checkpoint_dir": "ckpts/sft_sentiments", "tracker": "jsonl",
+        },
+    )
+    config.model.model_path = "gpt2"
+    config.model.model_overrides = dict(TINY_MODEL_OVERRIDES)
+    config.tokenizer.tokenizer_path = "bytes"
+    return config
+
+
+def main(hparams={}):
+    config = TRLConfig.update(build_config().to_dict(), hparams)
+    corpus = build_corpus(512)
+    positive = [s for s in corpus if lexicon_sentiment([s])[0] > 0]
+    trlx_tpu.train(
+        samples=positive,
+        eval_prompts=PROMPT_STUBS,
+        metric_fn=lambda samples, **kw: {"sentiment": lexicon_sentiment(samples)},
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})

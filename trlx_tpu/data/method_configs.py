@@ -9,32 +9,13 @@ the algorithm's loss function (implemented in JAX in `trlx_tpu.models.losses`).
 from dataclasses import dataclass, field
 from typing import Any, Dict
 
+from trlx_tpu.utils.registry import make_registry
+
 # name (lowercased) -> method config class
 _METHODS: Dict[str, type] = {}
 
-
-def register_method(name_or_cls=None):
-    """Decorator registering a method config class under its (lowercased) name.
-
-    Usage::
-
-        @register_method
-        class PPOConfig(MethodConfig): ...
-
-        @register_method("my_ppo")
-        class CustomPPO(MethodConfig): ...
-    """
-
-    def _register(cls, name=None):
-        key = (name or cls.__name__).lower()
-        _METHODS[key] = cls
-        return cls
-
-    if isinstance(name_or_cls, str):
-        return lambda cls: _register(cls, name_or_cls)
-    if name_or_cls is None:
-        return _register
-    return _register(name_or_cls)
+#: Decorator registering a method config class under its (lowercased) name.
+register_method = make_registry(_METHODS)
 
 
 def get_method(name: str) -> type:

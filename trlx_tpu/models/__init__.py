@@ -1,0 +1,9 @@
+from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
+from trlx_tpu.models.policy import (
+    CausalLMWithILQLHeads,
+    CausalLMWithValueHead,
+    apply_hydra_branch,
+    branch_param_subtree,
+)
+from trlx_tpu.models.heads import ILQLHeads, ValueHead, sync_target_q_heads
+from trlx_tpu.models.presets import PRESETS, from_hf_config, get_preset

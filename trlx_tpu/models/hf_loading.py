@@ -449,7 +449,7 @@ def make_hf_config(model_type: str, c: TransformerConfig):
         return transformers.GPT2Config(
             vocab_size=c.vocab_size, n_embd=c.hidden_size, n_layer=c.num_layers,
             n_head=c.num_heads, n_positions=c.max_position_embeddings,
-            layer_norm_epsilon=c.norm_eps,
+            n_inner=c.ffn_dim, layer_norm_epsilon=c.norm_eps,
         )
     if model_type == "llama":
         return transformers.LlamaConfig(
@@ -481,3 +481,80 @@ def make_hf_config(model_type: str, c: TransformerConfig):
             do_layer_norm_before=True,
         )
     raise ValueError(f"No HF config factory for {model_type!r}")
+
+
+# ------------------------------------------------------------------- T5 (seq2seq)
+
+
+def _t5_attn_to_params(sd, pre, has_bias):
+    p = {
+        "q": {"kernel": sd[f"{pre}.q.weight"].T},
+        "k": {"kernel": sd[f"{pre}.k.weight"].T},
+        "v": {"kernel": sd[f"{pre}.v.weight"].T},
+        "o": {"kernel": sd[f"{pre}.o.weight"].T},
+    }
+    if has_bias:
+        p["relative_attention_bias"] = {"embedding": sd[f"{pre}.relative_attention_bias.weight"]}
+    return p
+
+
+def _t5_ffn_to_params(sd, pre, gated):
+    if gated:
+        return {
+            "wi_0": {"kernel": sd[f"{pre}.wi_0.weight"].T},
+            "wi_1": {"kernel": sd[f"{pre}.wi_1.weight"].T},
+            "wo": {"kernel": sd[f"{pre}.wo.weight"].T},
+        }
+    return {"wi": {"kernel": sd[f"{pre}.wi.weight"].T}, "wo": {"kernel": sd[f"{pre}.wo.weight"].T}}
+
+
+def t5_state_dict_to_params(sd: Dict[str, np.ndarray], config) -> Dict[str, Any]:
+    """HF T5 state dict -> T5LM params (cites modeling_base.py:124 from_pretrained)."""
+    gated = config.is_gated
+    p: Dict[str, Any] = {
+        "shared": {"embedding": sd["shared.weight"]},
+        "encoder_ln": {"scale": sd["encoder.final_layer_norm.weight"]},
+        "decoder_ln": {"scale": sd["decoder.final_layer_norm.weight"]},
+    }
+    if not config.tie_word_embeddings and "lm_head.weight" in sd:
+        p["lm_head"] = {"kernel": sd["lm_head.weight"].T}
+    for i in range(config.num_layers):
+        pre = f"encoder.block.{i}"
+        p[f"encoder_blocks_{i}"] = {
+            "ln_1": {"scale": sd[f"{pre}.layer.0.layer_norm.weight"]},
+            "attn": _t5_attn_to_params(sd, f"{pre}.layer.0.SelfAttention", i == 0),
+            "ln_2": {"scale": sd[f"{pre}.layer.1.layer_norm.weight"]},
+            "mlp": _t5_ffn_to_params(sd, f"{pre}.layer.1.DenseReluDense", gated),
+        }
+    for i in range(config.num_decoder_layers):
+        pre = f"decoder.block.{i}"
+        p[f"decoder_blocks_{i}"] = {
+            "ln_1": {"scale": sd[f"{pre}.layer.0.layer_norm.weight"]},
+            "self_attn": _t5_attn_to_params(sd, f"{pre}.layer.0.SelfAttention", i == 0),
+            "ln_cross": {"scale": sd[f"{pre}.layer.1.layer_norm.weight"]},
+            "cross_attn": _t5_attn_to_params(sd, f"{pre}.layer.1.EncDecAttention", False),
+            "ln_2": {"scale": sd[f"{pre}.layer.2.layer_norm.weight"]},
+            "mlp": _t5_ffn_to_params(sd, f"{pre}.layer.2.DenseReluDense", gated),
+        }
+    return jax.tree.map(lambda x: np.asarray(x, np.float32), p)
+
+
+def load_pretrained_seq2seq(model_path: str, overrides: Optional[Dict[str, Any]] = None):
+    """Resolve (T5Config, params or None) for a seq2seq model path."""
+    from trlx_tpu.models.t5 import T5Config, from_hf_t5_config
+
+    config_path = os.path.join(model_path, "config.json")
+    if os.path.isdir(model_path) and os.path.exists(config_path):
+        import transformers
+
+        hf_config = transformers.AutoConfig.from_pretrained(model_path)
+        config = from_hf_t5_config(hf_config, overrides)
+        sd = load_torch_state_dict(model_path)
+        return config, t5_state_dict_to_params(sd, config)
+    config = T5Config()
+    if overrides:
+        config = config.replace(**overrides)
+    logger.warning(
+        f"No local checkpoint at {model_path!r}; using random-init T5 config (zero-egress)"
+    )
+    return config, None

@@ -106,6 +106,11 @@ class CausalLMWithILQLHeads(nn.Module):
         qs, target_qs, vs = self.ilql_heads(states_hs, actions_hs)
         return logits, qs, target_qs, vs, new_cache
 
+    def heads_only(self, hidden: jnp.ndarray):
+        """Apply the ILQL heads to trunk hidden states [B, T, H] (used by the
+        advantage-shaped decode, parity: modeling_ilql.py:325-412)."""
+        return self.ilql_heads(hidden, hidden)
+
 
 def branch_param_subtree(trunk_params: Dict[str, Any], start_layer: int, config: TransformerConfig) -> Dict[str, Any]:
     """Extract the frozen reference-branch params: top layers + final norm + output

@@ -72,6 +72,7 @@ def from_hf_config(hf_config, overrides: Optional[Dict[str, Any]] = None) -> Tra
         config = PRESETS["gpt2"].replace(
             vocab_size=hf_config.vocab_size, hidden_size=hf_config.n_embd,
             num_layers=hf_config.n_layer, num_heads=hf_config.n_head,
+            intermediate_size=getattr(hf_config, "n_inner", None),
             max_position_embeddings=hf_config.n_positions,
             norm_eps=hf_config.layer_norm_epsilon,
         )

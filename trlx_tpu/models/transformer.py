@@ -65,6 +65,7 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     remat: str = "none"  # "none" | "full" | "nothing_saveable" | "dots_saveable"
+    attention_impl: str = "xla"  # "xla" | "flash" (Pallas kernel for prefill/training)
 
     @property
     def kv_heads(self) -> int:
@@ -143,9 +144,11 @@ class Attention(nn.Module):
         mask_bias: jnp.ndarray,
         positions: jnp.ndarray,
         cache: Optional[Dict[str, jnp.ndarray]] = None,
+        kv_valid: Optional[jnp.ndarray] = None,
     ) -> Tuple[jnp.ndarray, Optional[Dict[str, jnp.ndarray]]]:
         """x: [B,T,Hid]; mask_bias additive [B,1,T,S]; cache holds this layer's k/v
-        [B,S,Hkv,D] plus the global write index."""
+        [B,S,Hkv,D] plus the global write index. ``kv_valid`` [B,T] enables the
+        Pallas flash path (no-cache forward only)."""
         c = self.config
         B, T, _ = x.shape
         dense = lambda feats, name, bias: nn.Dense(
@@ -179,11 +182,29 @@ class Attention(nn.Module):
             v = jnp.repeat(v, rep, axis=2)
 
         scale = 1.0 / math.sqrt(c.dim_per_head)
-        # [B,H,T,S]
-        scores = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
-        scores = scores + mask_bias
-        probs = jax.nn.softmax(scores, axis=-1).astype(c.compute_dtype)
-        out = jnp.einsum("bhts,bshd->bthd", probs, v)
+        # The flash path serves the cache-free forwards (training loss and the
+        # logprob/value scoring passes); cached prefill/decode must materialize
+        # k/v into the cache anyway and stays on the XLA path.
+        block = min(128, T)
+        use_flash = (
+            c.attention_impl == "flash"
+            and cache is None
+            and kv_valid is not None
+            and T % 8 == 0  # Mosaic sublane tiling
+            and T % block == 0
+        )
+        if use_flash:
+            from trlx_tpu.ops.attention import flash_attention
+            out = flash_attention(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+                kv_valid, True, scale, block, block, jax.default_backend() == "cpu",
+            ).transpose(0, 2, 1, 3).astype(c.compute_dtype)
+        else:
+            # [B,H,T,S]
+            scores = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+            scores = scores + mask_bias
+            probs = jax.nn.softmax(scores, axis=-1).astype(c.compute_dtype)
+            out = jnp.einsum("bhts,bshd->bthd", probs, v)
         out = out.reshape(B, T, c.num_heads * c.dim_per_head)
         out = dense(c.hidden_size, "o_proj", c.attn_bias)(out)
         return out, new_cache
@@ -211,15 +232,17 @@ class Block(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, mask_bias, positions, cache=None):
+    def __call__(self, x, mask_bias, positions, cache=None, kv_valid=None):
         c = self.config
         if c.parallel_residual:
             h1 = _norm_module(c, "ln_1")(x)
             h2 = h1 if c.shared_parallel_ln else _norm_module(c, "ln_2")(x)
-            attn_out, new_cache = Attention(c, name="attn")(h1, mask_bias, positions, cache)
+            attn_out, new_cache = Attention(c, name="attn")(h1, mask_bias, positions, cache, kv_valid)
             mlp_out = MLP(c, name="mlp")(h2)
             return x + attn_out + mlp_out, new_cache
-        attn_out, new_cache = Attention(c, name="attn")(_norm_module(c, "ln_1")(x), mask_bias, positions, cache)
+        attn_out, new_cache = Attention(c, name="attn")(
+            _norm_module(c, "ln_1")(x), mask_bias, positions, cache, kv_valid
+        )
         x = x + attn_out
         x = x + MLP(c, name="mlp")(_norm_module(c, "ln_2")(x))
         return x, new_cache
@@ -318,6 +341,7 @@ class TransformerLM(nn.Module):
             mask_bias = jnp.where(causal, 0.0, -1e9).astype(jnp.float32)
 
         x = self.embed(input_ids, positions)
+        kv_valid = attention_mask if cache is None else None
         branch_hidden = None
         new_layer_caches = []
         for i, layer in enumerate(self.layers):
@@ -326,7 +350,7 @@ class TransformerLM(nn.Module):
             layer_cache = None
             if cache is not None:
                 layer_cache = {"k": cache["k"][i], "v": cache["v"][i], "index": cache["index"]}
-            x, new_lc = layer(x, mask_bias, positions, layer_cache)
+            x, new_lc = layer(x, mask_bias, positions, layer_cache, kv_valid)
             if cache is not None:
                 new_layer_caches.append(new_lc)
         logits, hidden = self._final(x)
@@ -362,7 +386,7 @@ class TransformerLM(nn.Module):
         mask_bias = jnp.where(causal, 0.0, -1e9).astype(jnp.float32)
         x = hidden
         for layer in self.layers[start_layer:]:
-            x, _ = layer(x, mask_bias, positions, None)
+            x, _ = layer(x, mask_bias, positions, None, attention_mask)
         logits, _ = self._final(x)
         return logits
 

@@ -1,0 +1,190 @@
+"""Attention ops: a Pallas TPU flash-attention kernel + XLA reference.
+
+The reference relies on external CUDA attention kernels (HF/NeMo, SURVEY.md §2.4.5);
+this is the TPU-native equivalent. Forward is an online-softmax (FlashAttention-style)
+Pallas kernel: grid = (batch, heads, q_blocks, kv_blocks) with the kv axis innermost —
+TPU grids execute sequentially, so running max / denominator / accumulator live in
+VMEM scratch across kv steps and the output tile is written once on the last step.
+Causal blocks above the diagonal are skipped with ``@pl.when``. The backward pass
+recomputes attention in XLA (memory-efficient forward is what matters for the rollout
+path; training can additionally remat).
+
+Masking model matches :mod:`trlx_tpu.models.transformer`: slot-based causality plus a
+[B, S] key-validity mask (left-padded prompts). Engaged on the cache-free forwards —
+the training loss and the logprob/value scoring passes; cached generation
+prefill/decode stays on the XLA path (it must materialize K/V into the cache anyway).
+"""
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(
+    kv_valid_ref,  # [1, block_k] int32 (prefetched per kv block)
+    q_ref,  # [1, 1, block_q, D]
+    k_ref,  # [1, 1, block_k, D]
+    v_ref,  # [1, 1, block_k, D]
+    o_ref,  # [1, 1, block_q, D]
+    m_scratch,  # [block_q, 1] f32
+    l_scratch,  # [block_q, 1] f32
+    acc_scratch,  # [block_q, D] f32
+    *,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    kv_steps: int,
+):
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scratch[...] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[...] = jnp.zeros_like(l_scratch)
+        acc_scratch[...] = jnp.zeros_like(acc_scratch)
+
+    # skip fully-masked blocks above the causal diagonal
+    run = jnp.logical_or(
+        jnp.logical_not(causal), kj * block_k <= qi * block_q + (block_q - 1)
+    )
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)  # [bq, D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = kv_valid_ref[0][None, :] > 0
+        if causal:
+            mask = jnp.logical_and(mask, k_pos <= q_pos)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scratch[...]  # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # fully-masked rows keep m == NEG_INF; exp(s - m) would be exp(0) = 1 there
+        p = jnp.where(m_new > NEG_INF / 2, jnp.exp(s - m_new), 0.0)  # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
+        l_new = alpha * l_scratch[...] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scratch[...] = acc_scratch[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scratch[...] = m_new
+        l_scratch[...] = l_new
+
+    @pl.when(kj == kv_steps - 1)
+    def _finalize():
+        l = l_scratch[...]
+        # rows with no valid keys (fully masked) produce 0, not NaN
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0, ...] = (acc_scratch[...] / safe_l).astype(o_ref.dtype)
+
+
+def _flash_forward(
+    q: jnp.ndarray,  # [B, H, T, D]
+    k: jnp.ndarray,  # [B, H, S, D]
+    v: jnp.ndarray,
+    kv_valid: jnp.ndarray,  # [B, S] int32
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> jnp.ndarray:
+    B, H, T, D = q.shape
+    S = k.shape[2]
+    block_q = min(block_q, T)
+    block_k = min(block_k, S)
+    assert T % block_q == 0 and S % block_k == 0, (T, S, block_q, block_k)
+    kv_steps = S // block_k
+    grid = (B, H, T // block_q, kv_steps)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        causal=causal,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        kv_steps=kv_steps,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, j)),  # kv_valid
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(kv_valid.astype(jnp.int32), q, k, v)
+
+
+def xla_attention(q, k, v, kv_valid, causal: bool, scale: float) -> jnp.ndarray:
+    """Reference attention in plain XLA ([B,H,T,D] layout)."""
+    s = jnp.einsum("bhtd,bhsd->bhts", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    T, S = s.shape[-2], s.shape[-1]
+    mask = kv_valid[:, None, None, :] > 0
+    if causal:
+        q_pos = jnp.arange(T)[:, None]
+        k_pos = jnp.arange(S)[None, :]
+        mask = jnp.logical_and(mask, (k_pos <= q_pos)[None, None])
+    s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    # fully-masked rows -> 0
+    p = jnp.where(jnp.any(mask, axis=-1, keepdims=True), p, 0.0)
+    return jnp.einsum("bhts,bhsd->bhtd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
+)
+def flash_attention(
+    q, k, v, kv_valid, causal: bool = True, scale: Optional[float] = None,
+    block_q: int = 128, block_k: int = 128, interpret: bool = False,
+):
+    """Flash attention, [B,H,T,D] layout. Differentiable: backward recomputes
+    attention in XLA (forward stays O(T) memory for the rollout path)."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    return _flash_forward(q, k, v, kv_valid, causal, scale, block_q, block_k, interpret)
+
+
+def _fwd(q, k, v, kv_valid, causal, scale, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, kv_valid, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, kv_valid)
+
+
+def _bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, kv_valid = res
+    scale_ = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+
+    def ref(q, k, v):
+        return xla_attention(q, k, v, kv_valid, causal, scale_)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+flash_attention.defvjp(_fwd, _bwd)

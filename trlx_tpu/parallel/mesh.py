@@ -122,9 +122,15 @@ def put_batch(mesh: Mesh, batch):
     data loading with identical seeds); ``jax.make_array_from_process_local_data``
     carves out this host's shards.
     """
+    dp = dp_size(mesh)
+
     def _put(x):
         x = np.asarray(x)
-        sharding = batch_sharding(mesh, extra_dims=x.ndim - 1)
+        if x.ndim == 0 or x.shape[0] % dp != 0:
+            # uneven batches (e.g. small eval sets) replicate rather than fail
+            sharding = replicated(mesh)
+        else:
+            sharding = batch_sharding(mesh, extra_dims=x.ndim - 1)
         if jax.process_count() == 1:
             return jax.device_put(x, sharding)
         return jax.make_array_from_process_local_data(sharding, x)

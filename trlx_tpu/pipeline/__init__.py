@@ -17,22 +17,13 @@ from trlx_tpu.utils import logging
 
 logger = logging.get_logger(__name__)
 
+from trlx_tpu.utils.registry import make_registry
+
 # name (lowercased) -> pipeline class
 _DATAPIPELINES: Dict[str, type] = {}
 
-
-def register_datapipeline(name_or_cls=None):
-    """Decorator registering a pipeline class by (lowercased) name."""
-
-    def _register(cls, name=None):
-        _DATAPIPELINES[(name or cls.__name__).lower()] = cls
-        return cls
-
-    if isinstance(name_or_cls, str):
-        return lambda cls: _register(cls, name_or_cls)
-    if name_or_cls is None:
-        return _register
-    return _register(name_or_cls)
+#: Decorator registering a pipeline class by (lowercased) name.
+register_datapipeline = make_registry(_DATAPIPELINES)
 
 
 class NumpyLoader:

@@ -149,16 +149,10 @@ class DialogStore(BaseRolloutStore):
         pad = self.tokenizer.pad_token_id
 
         def collate(xs):
-            T = max(len(x["input_ids"]) for x in xs)
-            def rpad(v, value):
-                out = np.full((len(xs), T), value, v[0].dtype)
-                for i, row in enumerate(v):
-                    out[i, : len(row)] = row
-                return out
             return dict(
-                input_ids=rpad([x["input_ids"] for x in xs], pad),
-                attention_mask=rpad([x["attention_mask"] for x in xs], 0),
-                labels=rpad([x["labels"] for x in xs], self.IGNORE_INDEX),
+                input_ids=_rpad_stack([x["input_ids"] for x in xs], pad),
+                attention_mask=_rpad_stack([x["attention_mask"] for x in xs], 0),
+                labels=_rpad_stack([x["labels"] for x in xs], self.IGNORE_INDEX),
             )
 
         return NumpyLoader(self.history, batch_size, collate, shuffle=shuffle, seed=seed)

@@ -91,16 +91,24 @@ class ByteTokenizer(CharTokenizer):
         return [b + self._offset for b in text.encode("utf-8")]
 
     def decode(self, ids: Iterable[int], skip_special_tokens: bool = True) -> str:
-        bs = bytes(int(i) - self._offset for i in ids if int(i) >= self._offset)
-        txt = bs.decode("utf-8", errors="ignore")
-        if not skip_special_tokens:
-            specials = "".join(
-                {0: self.pad_token, 1: self.bos_token, 2: self.eos_token}[int(i)]
-                for i in ids
-                if int(i) < self._offset
-            )
-            return specials + txt
-        return txt
+        specials = {0: self.pad_token, 1: self.bos_token, 2: self.eos_token}
+        out = []
+        byte_run: list = []
+
+        def flush():
+            if byte_run:
+                out.append(bytes(byte_run).decode("utf-8", errors="ignore"))
+                byte_run.clear()
+
+        for i in map(int, ids):
+            if i >= self._offset:
+                byte_run.append(i - self._offset)
+            else:
+                flush()
+                if not skip_special_tokens:
+                    out.append(specials[i])
+        flush()
+        return "".join(out)
 
 
 class _Enc:

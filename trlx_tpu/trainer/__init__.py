@@ -1,0 +1,54 @@
+"""Trainer registry + abstract base (parity:
+`/root/reference/trlx/trainer/__init__.py:9-64`). Importing this package registers
+the built-in trainers."""
+
+from abc import abstractmethod
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from trlx_tpu.data.configs import TRLConfig
+
+from trlx_tpu.utils.registry import make_registry
+
+_TRAINERS: Dict[str, type] = {}
+
+#: Decorator registering a trainer class by (lowercased) name.
+register_trainer = make_registry(_TRAINERS)
+
+
+class BaseRLTrainer:
+    """Abstract trainer protocol: {learn, push_to_store, add pipelines}."""
+
+    def __init__(
+        self,
+        config: TRLConfig,
+        reward_fn: Optional[Callable] = None,
+        metric_fn: Optional[Callable] = None,
+        stop_sequences: Optional[List[str]] = None,
+        **kwargs,
+    ):
+        self.config = config
+        self.reward_fn = reward_fn
+        self.metric_fn = metric_fn
+        self.stop_sequences = stop_sequences or []
+
+    def push_to_store(self, data):
+        self.store.push(data)
+
+    def add_prompt_pipeline(self, pipeline):
+        """Attach the rollout prompt pipeline (PPO)."""
+        raise NotImplementedError
+
+    def add_eval_pipeline(self, eval_pipeline):
+        self.eval_pipeline = eval_pipeline
+
+    @abstractmethod
+    def learn(self):
+        """Run the training loop."""
+        ...
+
+
+from trlx_tpu.trainer.mesh_trainer import MeshRLTrainer  # noqa: E402,F401
+from trlx_tpu.trainer.ppo_trainer import PPOTrainer  # noqa: E402,F401
+from trlx_tpu.trainer.ilql_trainer import ILQLTrainer  # noqa: E402,F401
+from trlx_tpu.trainer.sft_trainer import SFTTrainer  # noqa: E402,F401
+from trlx_tpu.trainer.rft_trainer import RFTTrainer  # noqa: E402,F401

@@ -1,0 +1,218 @@
+"""ILQL trainer (parity: `/root/reference/trlx/trainer/accelerate_ilql_trainer.py`):
+offline experience building (returns standardization, last-action reward, action/state
+index bookkeeping), the ILQL loss driver, periodic Polyak target-Q sync, and the
+advantage-shaped generation used at evaluation.
+"""
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.ilql_types import ILQLBatch
+from trlx_tpu.methods.ilql import ILQLConfig, batched_index_select, topk_mask
+from trlx_tpu.models.hf_loading import load_pretrained
+from trlx_tpu.models.heads import sync_target_q_heads as _sync_heads
+from trlx_tpu.models.policy import CausalLMWithILQLHeads
+from trlx_tpu.models.transformer import TransformerLM
+from trlx_tpu.ops.generation import pad_to_bucket
+from trlx_tpu.parallel import mesh as mesh_lib
+from trlx_tpu.parallel.sharding import make_param_shardings
+from trlx_tpu.pipeline.offline_pipeline import ILQLRolloutStorage, tokenize_dialogue
+from trlx_tpu.trainer import register_trainer
+from trlx_tpu.trainer.mesh_trainer import MeshRLTrainer
+from trlx_tpu.utils import logging
+from trlx_tpu.utils.modeling import flatten_dict
+
+logger = logging.get_logger(__name__)
+
+BUCKETS = [2 ** i for i in range(2, 14)]
+
+
+def make_experience(samples, rewards, tokenizer=None, max_length: int = 2048, verbose: bool = True) -> ILQLRolloutStorage:
+    """Tokenize dialogues and compute ILQL index bookkeeping (parity:
+    accelerate_ilql_trainer.py:30-100): per-sample ``actions_ixs`` = positions whose
+    *next* token is an output token; ``states_ixs`` = actions + terminal; rewards are
+    standardized returns placed on the last action."""
+    if verbose:
+        logger.info("Collecting rollouts")
+    if tokenizer is not None:
+        samples = [tokenize_dialogue(s, tokenizer, max_length) for s in samples]
+
+    all_input_ids, all_actions_ixs, all_states_ixs, all_dones = [], [], [], []
+    for sample in samples:
+        length = 0
+        input_ids = np.asarray([t for msg in sample for t in msg.tokens], np.int32)
+        all_input_ids.append(input_ids)
+        actions_ixs = []
+        for dm in sample:
+            if dm.is_output:
+                actions_ixs.append(np.arange(length - 1, length + len(dm.tokens) - 1))
+            length += len(dm.tokens)
+        states_ixs = np.concatenate([*actions_ixs, [length - 1]])
+        all_dones.append(np.asarray([1] * (len(states_ixs) - 1) + [0], np.int32))
+        all_actions_ixs.append(np.concatenate(actions_ixs).astype(np.int32))
+        all_states_ixs.append(states_ixs.astype(np.int32))
+
+    returns = np.asarray(rewards, np.float64)
+    returns = returns - returns.mean()
+    std = returns.std()
+    if not np.isnan(std) and std > 0:
+        returns = returns / (std + np.finfo(returns.dtype).eps)
+    rewards_per_token = [np.zeros(len(x), np.float32) for x in all_actions_ixs]
+    for rs, ret in zip(rewards_per_token, returns):
+        rs[-1] = ret
+
+    attention_mask = [np.ones(len(x), np.int32) for x in all_input_ids]
+    return ILQLRolloutStorage(
+        all_input_ids, attention_mask, rewards_per_token, all_states_ixs, all_actions_ixs, all_dones
+    )
+
+
+@register_trainer
+class ILQLTrainer(MeshRLTrainer):
+    def __init__(self, config: TRLConfig, **kwargs):
+        super().__init__(config, **kwargs)
+        if not isinstance(config.method, ILQLConfig):
+            raise ValueError("ILQLTrainer requires method=ILQLConfig")
+        self.method: ILQLConfig = config.method
+        # `beta` shapes decode logits; it is not a generation-engine kwarg
+        self.ilql_beta = float(self.generate_kwargs.pop("beta", 1.0))
+        self._train_steps = {}
+        self._sync_fn = None
+
+    def setup_model(self):
+        overrides = dict(self.config.model.model_overrides or {})
+        overrides.setdefault("param_dtype", self.param_dtype)
+        overrides.setdefault("compute_dtype", self.compute_dtype)
+        overrides.setdefault("remat", self.config.mesh.remat)
+        self.model_config, trunk_params, self.model_type = load_pretrained(
+            self.config.model.model_path, overrides
+        )
+        self.module = CausalLMWithILQLHeads(self.model_config, two_qs=self.config.method.two_qs)
+        self.trunk_module = TransformerLM(self.model_config)
+
+        params = self.module.init(
+            jax.random.PRNGKey(self.config.train.seed),
+            jnp.zeros((1, 2), jnp.int32),
+            jnp.ones((1, 2), jnp.int32),
+        )["params"]
+        if trunk_params is not None:
+            params = dict(params)
+            params["transformer"] = trunk_params
+        # start target heads equal to online heads (parity: ILQLHeads init sync)
+        params["ilql_heads"] = _sync_heads(dict(params["ilql_heads"]), alpha=1.0)
+        shardings = make_param_shardings(params, self.mesh)
+        self.params = jax.tree.map(
+            lambda x, s: jax.device_put(jnp.asarray(x, self.param_dtype), s), params, shardings
+        )
+
+    def trainable_path_predicate(self, path: str) -> bool:
+        if "target_q_heads" in path:
+            return False  # target heads update only via Polyak sync
+        return super().trainable_path_predicate(path)
+
+    # ------------------------------------------------------------- generation
+
+    def gen_step_fn(self):
+        trunk = self.trunk_module
+
+        def step(params, ids, mask, positions, cache):
+            logits, hidden, _, cache = trunk.apply(
+                {"params": params["transformer"]}, ids, mask, positions, cache
+            )
+            return logits, hidden, cache
+
+        return step, lambda b, s: trunk.init_cache(b, s)
+
+    def gen_logits_processor(self):
+        """Perturb decode logits by beta*(minQ - V) from the target heads
+        (parity: modeling_ilql.py:325-412)."""
+        module = self.module
+        beta = self.ilql_beta
+
+        def processor(params, hidden, logits):
+            qs, target_qs, vs = module.apply(
+                {"params": {"ilql_heads": params["ilql_heads"]}},
+                hidden[:, None, :],
+                method=module.heads_only,
+            )
+            q = target_qs[0]
+            for tq in target_qs[1:]:
+                q = jnp.minimum(q, tq)
+            adv = q[:, 0, :] - vs[:, 0, :]
+            return logits + beta * adv
+
+        return processor
+
+    # ------------------------------------------------------------- experience
+
+    def make_experience(self, samples, rewards, max_length: int = 2048):
+        self.store = make_experience(samples, rewards, self.tokenizer, max_length)
+
+    # ------------------------------------------------------------- train loop
+
+    def prepare_learning(self):
+        bs = self.config.train.batch_size
+        self.num_mb = max(1, bs // (self.config.train.minibatch_size or bs))
+
+    def create_train_dataloader(self):
+        return self.store.create_loader(
+            self.config.train.batch_size, shuffle=True, seed=self.config.train.seed
+        )
+
+    def _get_train_step(self, B: int, T: int, A: int):
+        key = (B, T, A)
+        if key in self._train_steps:
+            return self._train_steps[key]
+        module, method = self.module, self.method
+
+        def loss_fn(params, mb: ILQLBatch):
+            logits, qs, target_qs, vs, _ = module.apply(
+                {"params": params}, mb.input_ids, mb.attention_mask, None,
+                mb.actions_ixs, mb.states_ixs,
+            )
+            action_logits = batched_index_select(logits, mb.actions_ixs)
+            loss, stats = method.loss((action_logits, (qs, target_qs, vs)), mb)
+            return loss, flatten_dict(stats)
+
+        self._train_steps[key] = self.make_grad_accum_step(loss_fn, self.num_mb)
+        return self._train_steps[key]
+
+    def train_step(self, batch: ILQLBatch) -> Dict[str, float]:
+        B, T = batch.input_ids.shape
+        A = batch.actions_ixs.shape[1]
+        Tb, Ab = pad_to_bucket(T, BUCKETS), pad_to_bucket(A, BUCKETS)
+        pad2 = lambda x, n, v=0: np.pad(np.asarray(x), ((0, 0), (0, n - x.shape[1])), constant_values=v)
+        padded = ILQLBatch(
+            input_ids=pad2(batch.input_ids, Tb, self.tokenizer.pad_token_id),
+            attention_mask=pad2(batch.attention_mask, Tb),
+            rewards=pad2(batch.rewards, Ab, 0.0),
+            states_ixs=pad2(batch.states_ixs, Ab + 1),
+            actions_ixs=pad2(batch.actions_ixs, Ab),
+            dones=pad2(batch.dones, Ab + 1),
+        )
+        dbatch = mesh_lib.put_batch(self.mesh, padded)
+        step = self._get_train_step(B, Tb, Ab)
+        with self.mesh:
+            self.params, self.opt_state, stats = step(self.params, self.opt_state, dbatch)
+        return {k: float(v) for k, v in jax.device_get(stats).items()}
+
+    def post_backward_callback(self):
+        """Polyak-sync target Q heads every ``steps_for_target_q_sync`` steps
+        (parity: accelerate_ilql_trainer.py:138-140)."""
+        if self.iter_count % self.method.steps_for_target_q_sync == 0:
+            if self._sync_fn is None:
+                alpha = self.method.alpha
+
+                def sync(params):
+                    new = dict(params)
+                    new["ilql_heads"] = _sync_heads(dict(params["ilql_heads"]), alpha)
+                    return new
+
+                self._sync_fn = jax.jit(sync, donate_argnums=0)
+            with self.mesh:
+                self.params = self._sync_fn(self.params)

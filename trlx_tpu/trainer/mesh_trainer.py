@@ -1,0 +1,459 @@
+"""MeshRLTrainer — the single shared trainer engine over a TPU mesh.
+
+This is the TPU-native replacement for BOTH reference backends (SURVEY.md §7): the
+Accelerate engine (`/root/reference/trlx/trainer/accelerate_base_trainer.py:40-682`)
+and the NeMo/Megatron one. One SPMD program over a ``data × fsdp × model`` mesh covers
+DP / ZeRO / TP / SP via PartitionSpecs, so there is exactly one code path.
+
+Responsibilities (reference line refs in method docstrings): model+optimizer setup
+with layer freezing, jitted gradient-accumulation train step, the jitted generation
+engine with shape bucketing, stop-sequence decode, distributed evaluate, the main
+``learn()`` loop with periodic eval/checkpoint/save-best, checkpoint save/load, and
+tracker logging with the reference's stat names.
+"""
+
+import json
+import os
+from abc import abstractmethod
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.ops.generation import generate as generate_op
+from trlx_tpu.ops.generation import left_pad_batch, pad_to_bucket
+from trlx_tpu.parallel import mesh as mesh_lib
+from trlx_tpu.parallel.sharding import make_param_shardings, shard_params
+from trlx_tpu.pipeline.tokenization import load_tokenizer
+from trlx_tpu.trainer import BaseRLTrainer, register_trainer
+from trlx_tpu.utils import (
+    Clock,
+    get_git_tag,
+    get_optimizer_class,
+    get_scheduler_class,
+    infinite_loader,
+    set_seed,
+    significant,
+)
+from trlx_tpu.utils import logging
+from trlx_tpu.utils.modeling import flatten_dict
+from trlx_tpu.utils.trackers import make_tracker
+
+logger = logging.get_logger(__name__)
+
+
+@register_trainer
+class MeshRLTrainer(BaseRLTrainer):
+    """Shared engine; algorithm trainers subclass and provide
+    ``setup_model / create_train_dataloader / train_step / prepare_learning``."""
+
+    def __init__(self, config: TRLConfig, **kwargs):
+        super().__init__(config, **kwargs)
+        self.np_rng = set_seed(config.train.seed)
+        self.rng = jax.random.PRNGKey(config.train.seed + jax.process_index())
+        mesh_lib.initialize_distributed()
+        self.mesh = mesh_lib.mesh_from_config(config.mesh)
+        self.tokenizer = load_tokenizer(config.tokenizer)
+
+        self.compute_dtype = jnp.dtype(config.mesh.compute_dtype)
+        self.param_dtype = jnp.dtype(config.mesh.param_dtype)
+
+        self.setup_model()
+        self.setup_optimizer()
+
+        self.iter_count = 0
+        self.nth_evaluation = 0
+        self.best_reward = -float("inf")
+        self.clock = Clock()
+        self.generate_kwargs = dict(getattr(config.method, "gen_kwargs", {}) or {})
+        self.generate_experience_kwargs = getattr(config.method, "gen_experience_kwargs", None)
+        self._compiled_generate = {}
+
+        run_name = config.train.run_name
+        if run_name is None:
+            tag, branch = get_git_tag()
+            config.train.run_name = run_name = (
+                f"{config.model.model_path.split('/')[-1]}"
+                f"/{jax.device_count()}chips:{branch}"
+            ).replace("/", "_")
+        self.tracker = make_tracker(config.train, config.to_dict())
+
+    # ------------------------------------------------------------- model setup
+
+    @abstractmethod
+    def setup_model(self):
+        """Set self.module, self.params (sharded train_state pytree incl. heads),
+        self.model_config, self.model_type."""
+        ...
+
+    def trainable_path_predicate(self, path: str) -> bool:
+        """Which params receive gradients (parity: ``freeze_bottom_causal_layers``,
+        reference utils/modeling.py:22-45): with num_layers_unfrozen = N > 0, only
+        the top N transformer layers and all heads train; -1 trains everything."""
+        n_unfrozen = self.config.model.num_layers_unfrozen
+        if n_unfrozen < 0:
+            return True
+        if "transformer" not in path:
+            return True  # heads always train
+        if "layers_" in path:
+            layer = int(path.split("layers_")[1].split("/")[0])
+            return layer >= self.model_config.num_layers - n_unfrozen
+        # embeddings / final norm / lm_head of the trunk
+        return False
+
+    def _trainable_labels(self, params) -> Any:
+        def build(tree, prefix=""):
+            if isinstance(tree, dict):
+                return {k: build(v, f"{prefix}/{k}" if prefix else str(k)) for k, v in tree.items()}
+            return "train" if self.trainable_path_predicate(prefix) else "freeze"
+
+        return build(params)
+
+    def setup_optimizer(self):
+        """optax optimizer + schedule from the registries (parity:
+        accelerate_base_trainer.py:173-201), masked by the freeze predicate, with
+        optimizer state sharded like the params (ZeRO analogue)."""
+        opt_config = self.config.optimizer
+        kwargs = dict(opt_config.kwargs)
+        lr = kwargs.pop("lr", 1e-5)
+        sched_kwargs = dict(self.config.scheduler.kwargs)
+        sched_lr = sched_kwargs.pop("learning_rate", lr)
+        self.lr_schedule = get_scheduler_class(self.config.scheduler.name)(
+            learning_rate=sched_lr, **sched_kwargs
+        )
+        max_grad_norm = kwargs.pop("max_grad_norm", None)
+        tx = get_optimizer_class(opt_config.name)(learning_rate=self.lr_schedule, **kwargs)
+        if max_grad_norm:
+            tx = optax.chain(optax.clip_by_global_norm(max_grad_norm), tx)
+        labels = self._trainable_labels(self.params)
+        self.tx = optax.multi_transform({"train": tx, "freeze": optax.set_to_zero()}, labels)
+        with self.mesh:
+            self.opt_state = jax.jit(self.tx.init)(self.params)
+
+    # -------------------------------------------------------------- train step
+
+    def make_grad_accum_step(self, loss_fn: Callable, num_mb: int, donate: bool = True):
+        """Build the jitted optimizer step: scan over ``num_mb`` microbatches
+        accumulating grads (replaces torch grad-accum no_sync windows,
+        accelerate_base_trainer.py:502-516), then one optax update.
+
+        ``loss_fn(params, microbatch) -> (loss, stats_dict)``.
+        """
+
+        def step(params, opt_state, batch):
+            mbs = jax.tree.map(lambda x: x.reshape((num_mb, x.shape[0] // num_mb) + x.shape[1:]), batch)
+
+            def body(grads_acc, mb):
+                (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, mb)
+                grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+                return grads_acc, (loss, stats)
+
+            zero_grads = jax.tree.map(jnp.zeros_like, params)
+            grads, (losses, stats) = jax.lax.scan(body, zero_grads, mbs)
+            grads = jax.tree.map(lambda g: g / num_mb, grads)
+            updates, new_opt_state = self.tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            mean_stats = jax.tree.map(lambda x: jnp.mean(x, axis=0), stats)
+            mean_stats["learning_rate_group_0"] = self.lr_schedule(
+                _opt_step_count(opt_state)
+            )
+            return new_params, new_opt_state, mean_stats
+
+        return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+    # -------------------------------------------------------------- generation
+
+    @abstractmethod
+    def gen_step_fn(self):
+        """Return step_fn(params, ids, mask, positions, cache)->(logits,hidden,cache)
+        and init_cache_fn(batch, total_len) for the generation engine."""
+        ...
+
+    def gen_logits_processor(self):
+        """Optional decode-time logits processor (ILQL advantage shaping)."""
+        return None
+
+    def generate(self, prompts_ids: List[np.ndarray], eval_mode: bool = False, **kwargs):
+        """Generate continuations for a list of ragged prompt id arrays.
+
+        Host side: bucket-pad prompts (left) to limit recompiles; device side: one
+        compiled generate per (B, P, gen-kwargs) key. Parity:
+        accelerate_base_trainer.py:256-283 (generate vs generate_eval kwargs).
+        """
+        gen_kwargs = dict(self.generate_kwargs)
+        if not eval_mode and self.generate_experience_kwargs:
+            gen_kwargs = dict(self.generate_experience_kwargs)
+        gen_kwargs.update(kwargs)
+        gen_kwargs.setdefault("eos_token_id", self.tokenizer.eos_token_id)
+        gen_kwargs.setdefault("pad_token_id", self.tokenizer.pad_token_id)
+        max_new = int(gen_kwargs.pop("max_new_tokens", 16))
+
+        max_len = max(len(p) for p in prompts_ids)
+        buckets = [2 ** i for i in range(3, 14)]
+        P = pad_to_bucket(max_len, buckets)
+        ids, mask = left_pad_batch(prompts_ids, gen_kwargs["pad_token_id"], P)
+
+        key = (ids.shape, max_new, tuple(sorted(gen_kwargs.items())))
+        if key not in self._compiled_generate:
+            step_fn, init_cache_fn = self.gen_step_fn()
+            fn = partial(
+                generate_op,
+                step_fn,
+                init_cache_fn=init_cache_fn,
+                max_new_tokens=max_new,
+                logits_processor=self.gen_logits_processor(),
+                **gen_kwargs,
+            )
+            self._compiled_generate[key] = jax.jit(
+                lambda params, i, m, r: fn(params, input_ids=i, attention_mask=m, rng=r)
+            )
+        self.rng, sub = jax.random.split(self.rng)
+        batch = mesh_lib.put_batch(self.mesh, {"ids": ids, "mask": mask})
+        with self.mesh:
+            out = self._compiled_generate[key](self.params, batch["ids"], batch["mask"], sub)
+        return (
+            np.asarray(jax.device_get(out["sequences"])),
+            np.asarray(jax.device_get(out["response_mask"])),
+            P,
+        )
+
+    def decode(
+        self,
+        prompts: List[np.ndarray],
+        samples: np.ndarray,
+        prompt_pad_len: int,
+        append_eos: bool = False,
+    ) -> Tuple[List[str], List[str], List[str], List[np.ndarray]]:
+        """Decode generated sequences into (str_samples, str_prompts, str_outputs,
+        trimmed_output_ids), trimming at the first stop sequence and (optionally)
+        re-appending eos (parity: accelerate_base_trainer.py:203-255)."""
+        str_samples, str_prompts, str_outputs, out_ids = [], [], [], []
+        for i, prompt in enumerate(prompts):
+            str_prompt = self.tokenizer.decode(prompt, skip_special_tokens=True)
+            resp = samples[i, prompt_pad_len:]
+            str_output = self.tokenizer.decode(resp, skip_special_tokens=True)
+            for stop in self.stop_sequences:
+                stop_ix = str_output.find(stop)
+                if stop_ix >= 0:
+                    str_output = str_output[:stop_ix].rstrip()
+            trimmed = self.tokenizer(str_output).input_ids
+            if append_eos and self.tokenizer.eos_token_id is not None:
+                trimmed = list(trimmed) + [self.tokenizer.eos_token_id]
+            if len(trimmed) == 0:  # never emit empty responses (breaks PPO shapes)
+                trimmed = [self.tokenizer.eos_token_id or 0]
+            str_samples.append(str_prompt + str_output)
+            str_prompts.append(str_prompt)
+            str_outputs.append(str_output)
+            out_ids.append(np.asarray(trimmed, np.int32))
+        return str_samples, str_prompts, str_outputs, out_ids
+
+    # -------------------------------------------------------------- evaluation
+
+    def evaluate(self) -> Dict[str, Any]:
+        """Generate on eval prompts, score with reward_fn/metric_fn, log a sample
+        table (parity: accelerate_base_trainer.py:339-500, incl. gen-kwarg sweeps
+        via list-valued gen_kwargs)."""
+        logger.info("Evaluating model")
+        stats: Dict[str, Any] = {}
+        sweep_keys = [k for k, v in self.generate_kwargs.items() if isinstance(v, list)]
+        sweeps = [{}]
+        if sweep_keys:
+            sweeps = []
+            base = {k: v for k, v in self.generate_kwargs.items() if k not in sweep_keys}
+            from itertools import product
+
+            for combo in product(*[self.generate_kwargs[k] for k in sweep_keys]):
+                sweeps.append({**base, **dict(zip(sweep_keys, combo))})
+
+        for sweep_kwargs in sweeps:
+            suffix = "".join(f"@{k}={v}" for k, v in sweep_kwargs.items() if k in sweep_keys)
+            all_prompts, all_samples, all_masks, meta = [], [], [], {}
+            pad_len = None
+            for batch in self.eval_pipeline.create_loader(self.config.train.batch_size):
+                prompts = batch["input_ids"]
+                samples, resp_mask, pad_len = self.generate(prompts, eval_mode=True, **sweep_kwargs)
+                all_prompts.extend(prompts)
+                all_samples.append(samples)
+                all_masks.append(resp_mask)
+                for k, v in batch.items():
+                    if k != "input_ids":
+                        meta.setdefault(k, []).extend(v)
+            R = max(s.shape[1] for s in all_samples)
+            samples = np.concatenate(
+                [np.pad(s, ((0, 0), (0, R - s.shape[1])), constant_values=self.tokenizer.pad_token_id) for s in all_samples]
+            )
+            str_samples, str_prompts, str_outputs, _ = self.decode(all_prompts, samples, pad_len)
+
+            columns = ["prompt", "output"]
+            columns_data = [str_prompts, str_outputs]
+            if self.reward_fn is not None:
+                rewards = self.reward_fn(
+                    samples=str_samples, prompts=str_prompts, outputs=str_outputs,
+                    tokenizer=self.tokenizer, **meta,
+                )
+                rewards = [float(np.sum(r)) if np.ndim(r) > 0 else float(r) for r in rewards]
+                columns.append("reward")
+                columns_data.append(rewards)
+                stats[f"reward/mean{suffix}"] = float(np.mean(rewards))
+                stats[f"reward/std{suffix}"] = float(np.std(rewards))
+            if self.metric_fn is not None:
+                metrics = self.metric_fn(
+                    samples=str_samples, prompts=str_prompts, outputs=str_outputs, **meta
+                )
+                for k, xs in metrics.items():
+                    stats[f"metrics/{k}{suffix}"] = float(np.mean(xs))
+                    if np.ndim(xs) > 0 and len(xs) == len(str_samples):
+                        columns.append(k)
+                        columns_data.append(list(map(float, xs)))
+            rows = list(zip(*columns_data))
+            if jax.process_index() == 0:
+                self.tracker.log_table(f"samples{suffix}", columns, [list(r) for r in rows], self.iter_count)
+                for row in rows[:4]:
+                    logger.info(" | ".join(str(c)[:72] for c in row))
+        self.nth_evaluation += 1
+        return stats
+
+    # -------------------------------------------------------------- main loop
+
+    @abstractmethod
+    def create_train_dataloader(self):
+        ...
+
+    @abstractmethod
+    def train_step(self, batch) -> Dict[str, float]:
+        """One optimizer step on a host batch; returns flat stats."""
+        ...
+
+    def prepare_learning(self):
+        pass
+
+    def post_epoch_callback(self, epoch: int):
+        pass
+
+    def post_backward_callback(self):
+        pass
+
+    def learn(self):
+        """Main training loop (parity: accelerate_base_trainer.py:518-652)."""
+        train_config = self.config.train
+        self.prepare_learning()
+        self.iter_count = 0
+
+        if train_config.resume_from_checkpoint and os.path.exists(train_config.resume_from_checkpoint):
+            self.load(train_config.resume_from_checkpoint)
+
+        results = self.evaluate() if getattr(self, "eval_pipeline", None) else {}
+        self.tracker.log(results, self.iter_count)
+
+        for epoch in range(train_config.epochs):
+            for batch in self.create_train_dataloader():
+                forward_time = self.clock.tick()
+                stats = self.train_step(batch)
+                stats["time/forward_backward"] = self.clock.tick()
+                self.iter_count += 1
+                self.post_backward_callback()
+
+                if (
+                    train_config.checkpoint_interval
+                    and self.iter_count % train_config.checkpoint_interval == 0
+                ):
+                    subfolder = f"checkpoint_{self.iter_count:0{len(str(train_config.total_steps))}d}"
+                    self.save(os.path.join(train_config.checkpoint_dir, subfolder))
+                    self.save_pretrained(os.path.join(train_config.checkpoint_dir, "hf_model"))
+
+                if (
+                    train_config.eval_interval
+                    and self.iter_count % train_config.eval_interval == 0
+                ) or self.iter_count >= train_config.total_steps:
+                    results = self.evaluate() if getattr(self, "eval_pipeline", None) else {}
+                    stats.update(results)
+                    if train_config.save_best and "reward/mean" in results:
+                        # under SPMD every process computes the same global reward,
+                        # replacing the reference's MAX all-reduce guard (:616-638)
+                        if results["reward/mean"] > self.best_reward:
+                            self.best_reward = results["reward/mean"]
+                            self.save(os.path.join(train_config.checkpoint_dir, "best_checkpoint"))
+
+                stats = {k: significant(v) if isinstance(v, float) else v for k, v in stats.items()}
+                self.tracker.log(stats, self.iter_count)
+                if self.iter_count % 10 == 0 or self.iter_count == 1:
+                    brief = {k: v for k, v in stats.items() if "loss" in k or "reward" in k}
+                    logger.info(f"step {self.iter_count}/{train_config.total_steps} {brief}")
+
+                if self.iter_count >= train_config.total_steps:
+                    self.save(os.path.join(train_config.checkpoint_dir, f"checkpoint_{self.iter_count}"))
+                    return results
+            self.post_epoch_callback(epoch)
+        return results
+
+    # ------------------------------------------------------------- checkpoints
+
+    def save(self, directory: str):
+        """Sharded checkpoint (params, opt_state, iter_count) via orbax (parity:
+        accelerator.save_state, accelerate_base_trainer.py:309-317)."""
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(directory)
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.join(path, "params"), self.params, force=True)
+        if self.config.train.save_optimizer:
+            ckptr.save(os.path.join(path, "opt_state"), self.opt_state, force=True)
+        ckptr.wait_until_finished()
+        if jax.process_index() == 0:
+            with open(os.path.join(path, "state.json"), "w") as f:
+                json.dump({"iter_count": self.iter_count, "best_reward": self.best_reward}, f)
+        logger.info(f"Saved checkpoint to {path}")
+
+    def load(self, directory: str):
+        """Restore a checkpoint saved by :meth:`save` (parity:
+        accelerate_base_trainer.py:318-333)."""
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(directory)
+        ckptr = ocp.StandardCheckpointer()
+        self.params = ckptr.restore(os.path.join(path, "params"), self.params)
+        opt_path = os.path.join(path, "opt_state")
+        if os.path.exists(opt_path) and self.config.train.save_optimizer:
+            self.opt_state = ckptr.restore(opt_path, self.opt_state)
+        state_path = os.path.join(path, "state.json")
+        if os.path.exists(state_path):
+            with open(state_path) as f:
+                state = json.load(f)
+            self.iter_count = state.get("iter_count", 0)
+            self.best_reward = state.get("best_reward", -float("inf"))
+        logger.info(f"Restored checkpoint from {path} (iter {self.iter_count})")
+
+    def save_pretrained(self, directory: str):
+        """Export the trunk in HF format + heads as msgpack (parity:
+        accelerate_base_trainer.py:284-307; heads-only extras mirror the peft
+        state-dict surgery in modeling_base.py:347-353)."""
+        from flax.serialization import to_bytes
+
+        from trlx_tpu.models.hf_loading import save_pretrained_hf
+
+        params = jax.device_get(self.params)
+        trunk = params.get("transformer", params)
+        os.makedirs(directory, exist_ok=True)
+        if jax.process_index() == 0:
+            try:
+                save_pretrained_hf(directory, self.model_type, trunk, self.model_config)
+            except Exception as e:
+                logger.warning(f"HF export unavailable ({e}); saving native params only")
+            heads = {k: v for k, v in params.items() if k != "transformer"}
+            if heads:
+                with open(os.path.join(directory, "heads.msgpack"), "wb") as f:
+                    f.write(to_bytes(heads))
+
+
+def _opt_step_count(opt_state) -> jnp.ndarray:
+    """Best-effort extraction of the optax step count for LR logging."""
+    leaves = jax.tree.leaves(opt_state)
+    for leaf in leaves:
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.integer) and leaf.ndim == 0:
+            return leaf
+    return jnp.array(0)

@@ -1,0 +1,348 @@
+"""PPO trainer (parity: `/root/reference/trlx/trainer/accelerate_ppo_trainer.py:35-553`):
+rollout store management, hydra-vs-full reference model, KL controllers, the
+``make_experience`` pipeline (generate → reward → logprob/value/ref passes → KL
+penalty → rollout store), and the PPO loss driver.
+
+TPU-first shape: rollout generation and the scoring forwards are jitted fixed-shape
+SPMD programs; the reference's rank-0 ``broadcast``/``scatter`` of reward scores
+(:325-338) disappears because reward_fn runs on the single controller and scores are
+placed onto the mesh with the batch.
+"""
+
+from functools import partial
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.ppo_types import PPORLBatch, PPORLElement
+from trlx_tpu.methods.ppo import PPOConfig
+from trlx_tpu.models.hf_loading import init_params, load_pretrained
+from trlx_tpu.models.policy import (
+    CausalLMWithValueHead,
+    branch_param_subtree,
+)
+from trlx_tpu.models.transformer import TransformerLM
+from trlx_tpu.parallel import mesh as mesh_lib
+from trlx_tpu.parallel.sharding import make_param_shardings
+from trlx_tpu.pipeline import MiniBatchIterator
+from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
+from trlx_tpu.trainer import register_trainer
+from trlx_tpu.trainer.mesh_trainer import MeshRLTrainer
+from trlx_tpu.utils import infinite_loader, logging
+from trlx_tpu.utils.modeling import RunningMoments, flatten_dict, logprobs_of_labels
+
+logger = logging.get_logger(__name__)
+
+
+@register_trainer
+class PPOTrainer(MeshRLTrainer):
+    def __init__(self, config: TRLConfig, **kwargs):
+        super().__init__(config, **kwargs)
+        if not isinstance(config.method, PPOConfig):
+            raise ValueError("PPOTrainer requires method=PPOConfig")
+        self.method: PPOConfig = config.method
+
+        self.store = PPORolloutStorage(self.tokenizer.pad_token_id)
+        self.kl_ctl = self.method.kl_controller()
+        self.running_moments = RunningMoments()
+        self.mean_kl = 0.0
+        self.rollout_stats: Dict[str, float] = {}
+        self._score_fns = {}
+        self._train_steps = {}
+
+        if config.train.rollout_logging_dir is not None:
+            self.log_rollouts = True
+            self.setup_rollout_logging(config)
+        else:
+            self.log_rollouts = False
+
+    # ------------------------------------------------------------------ model
+
+    def setup_model(self):
+        """Build policy+value model; reference model is either the hydra frozen
+        top-branch (num_layers_unfrozen > 0) or a full frozen param copy
+        (parity: get_arch + ref_model setup, accelerate_ppo_trainer.py:65-108)."""
+        overrides = dict(self.config.model.model_overrides or {})
+        overrides.setdefault("param_dtype", self.param_dtype)
+        overrides.setdefault("compute_dtype", self.compute_dtype)
+        overrides.setdefault("remat", self.config.mesh.remat)
+        self.model_config, trunk_params, self.model_type = load_pretrained(
+            self.config.model.model_path, overrides
+        )
+        self.module = CausalLMWithValueHead(self.model_config)
+        self.trunk_module = TransformerLM(self.model_config)
+
+        params = self.module.init(
+            jax.random.PRNGKey(self.config.train.seed),
+            jnp.zeros((1, 2), jnp.int32),
+            jnp.ones((1, 2), jnp.int32),
+        )["params"]
+        if trunk_params is not None:
+            params = dict(params)
+            params["transformer"] = trunk_params
+
+        shardings = make_param_shardings(params, self.mesh)
+        self.params = jax.tree.map(
+            lambda x, s: jax.device_put(jnp.asarray(x, self.param_dtype), s), params, shardings
+        )
+
+        # The reference copies must NOT alias self.params: the train step donates
+        # its input buffers (real buffer reuse on TPU), so aliased frozen params
+        # would be deleted after the first optimizer step.
+        def device_copy(tree):
+            with self.mesh:
+                return jax.jit(lambda t: jax.tree.map(lambda x: x.copy(), t))(tree)
+
+        n_unfrozen = self.config.model.num_layers_unfrozen
+        if n_unfrozen > 0:
+            self.branch_start = self.model_config.num_layers - n_unfrozen
+            branch = branch_param_subtree(self.params["transformer"], self.branch_start, self.model_config)
+            self.frozen_branch_params = device_copy(branch)
+            self.ref_params = None
+        else:
+            self.branch_start = None
+            self.frozen_branch_params = None
+            self.ref_params = device_copy(self.params["transformer"])
+
+    # ------------------------------------------------------------- generation
+
+    def gen_step_fn(self):
+        trunk = self.trunk_module
+
+        def step(params, ids, mask, positions, cache):
+            logits, hidden, _, cache = trunk.apply(
+                {"params": params["transformer"]}, ids, mask, positions, cache
+            )
+            return logits, hidden, cache
+
+        init_cache = lambda b, s: trunk.init_cache(b, s)
+        return step, init_cache
+
+    # ------------------------------------------------------------- experience
+
+    def add_prompt_pipeline(self, pipeline):
+        """Attach the prompt pipeline for rollouts (parity: :245-249)."""
+        loader = pipeline.create_loader(self.method.chunk_size, shuffle=True, seed=self.config.train.seed)
+        self.prompt_iterator = infinite_loader(loader)
+
+    def setup_rollout_logging(self, config):
+        import os
+
+        assert os.path.isdir(config.train.rollout_logging_dir)
+        import uuid
+
+        self.run_id = f"run-{uuid.uuid4()}"
+        self.rollout_logging_dir = os.path.join(config.train.rollout_logging_dir, self.run_id)
+        os.mkdir(self.rollout_logging_dir)
+        with open(os.path.join(self.rollout_logging_dir, "config.json"), "w") as f:
+            import json
+
+            f.write(json.dumps(config.to_dict(), indent=2))
+
+    def _get_score_fn(self, B: int, P: int, R: int):
+        """Jitted scoring pass: policy logprobs+values and reference logprobs over
+        the response window (parity: :414-446). One compile per (B, P, R)."""
+        key = (B, P, R)
+        if key in self._score_fns:
+            return self._score_fns[key]
+
+        module, trunk = self.module, self.trunk_module
+        branch_start = self.branch_start
+
+        def score(params, ref_params, frozen_branch, seq, mask):
+            logits, values, branch_hidden, _ = module.apply(
+                {"params": params}, seq, mask, branch_layer=branch_start
+            )
+            logprobs = logprobs_of_labels(logits[:, :-1], seq[:, 1:])
+            if branch_start is not None:
+                ref_logits = module.apply(
+                    {"params": {"transformer": frozen_branch}},
+                    branch_hidden, mask, None, branch_start,
+                    method=module.forward_branch,
+                )
+            else:
+                ref_logits, _, _, _ = trunk.apply({"params": ref_params}, seq, mask)
+            ref_logprobs = logprobs_of_labels(ref_logits[:, :-1], seq[:, 1:])
+            start = P - 1
+            return (
+                logprobs[:, start : start + R],
+                values[:, start : start + R].astype(jnp.float32),
+                ref_logprobs[:, start : start + R],
+            )
+
+        self._score_fns[key] = jax.jit(score)
+        return self._score_fns[key]
+
+    def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0):
+        """Roll out prompts → generations → rewards → KL-penalized per-token reward
+        assembly → rollout store (parity: :251-524; see SURVEY.md §3.2)."""
+        logger.info(f"Collecting {num_rollouts} rollouts")
+        ppo_rl_elements: List[PPORLElement] = []
+        accumulated_kl = []
+        all_scores_log = []
+        self.clock.tick()
+
+        while len(ppo_rl_elements) < num_rollouts:
+            batch = next(self.prompt_iterator)
+            prompts = batch["input_ids"]
+            metadata = {k: v for k, v in batch.items() if k != "input_ids"}
+
+            samples, resp_mask, pad_len = self.generate(prompts, eval_mode=False)
+            str_samples, str_prompts, str_outputs, out_ids = self.decode(
+                prompts, samples, pad_len, append_eos=True
+            )
+
+            scores = self.reward_fn(
+                samples=str_samples, prompts=str_prompts, outputs=str_outputs,
+                tokenizer=self.tokenizer, **metadata,
+            )
+            dense = np.ndim(scores[0]) > 0
+            if dense:
+                dense_scores = [np.asarray(s, np.float32) for s in scores]
+                scores = np.asarray([s.sum() for s in dense_scores], np.float32)
+            else:
+                dense_scores = None
+                scores = np.asarray(jax.device_get(scores), np.float32).reshape(-1)
+
+            all_scores_log.extend(scores.tolist())
+            # clip + normalize scores (parity: :364-381)
+            scores_mean, scores_std = self.running_moments.update(scores)
+            if self.method.cliprange_reward:
+                scores = np.clip(scores, -self.method.cliprange_reward, self.method.cliprange_reward)
+            if self.method.scale_reward == "running":
+                scores = scores / max(self.running_moments.std, 1e-8)
+            elif self.method.scale_reward == "ref":
+                scores = scores / max(self.method.ref_std or 1.0, 1e-8)
+
+            # fixed-shape scoring forward
+            P = max(len(p) for p in prompts)
+            R = max(len(o) for o in out_ids)
+            from trlx_tpu.ops.generation import left_pad_batch, pad_to_bucket
+
+            P = pad_to_bucket(P, [2 ** i for i in range(3, 14)])
+            R = pad_to_bucket(R, [2 ** i for i in range(3, 14)])
+            q_ids, q_mask = left_pad_batch(prompts, self.tokenizer.pad_token_id, P)
+            r_ids = np.full((len(out_ids), R), self.tokenizer.pad_token_id, np.int32)
+            r_mask = np.zeros((len(out_ids), R), np.int32)
+            for i, o in enumerate(out_ids):
+                r_ids[i, : len(o)] = o
+                r_mask[i, : len(o)] = 1
+            seq = np.concatenate([q_ids, r_ids], axis=1)
+            mask = np.concatenate([q_mask, r_mask], axis=1)
+
+            dbatch = mesh_lib.put_batch(self.mesh, {"seq": seq, "mask": mask})
+            score_fn = self._get_score_fn(seq.shape[0], P, R)
+            with self.mesh:
+                logprobs, values, ref_logprobs = score_fn(
+                    self.params, self.ref_params, self.frozen_branch_params,
+                    dbatch["seq"], dbatch["mask"],
+                )
+            logprobs = np.asarray(jax.device_get(logprobs))
+            values = np.asarray(jax.device_get(values))
+            ref_logprobs = np.asarray(jax.device_get(ref_logprobs))
+
+            # per-token KL penalty & reward assembly (parity: :457-492)
+            log_ratio = (logprobs - ref_logprobs) * r_mask
+            kl_per_token = np.exp(-log_ratio) - 1.0 + log_ratio  # k3 estimator
+            mean_kl = (kl_per_token.sum(axis=1) / np.maximum(r_mask.sum(axis=1), 1)).mean()
+            accumulated_kl.append(mean_kl)
+
+            kl_coef = self.kl_ctl.value
+            for i in range(len(prompts)):
+                l = int(r_mask[i].sum())
+                rewards = -kl_coef * log_ratio[i, :l]
+                if dense:
+                    ds = dense_scores[i]
+                    rewards[: min(l, len(ds))] += ds[: min(l, len(ds))]
+                else:
+                    rewards[l - 1] += scores[i]
+                ppo_rl_elements.append(
+                    PPORLElement(
+                        query_tensor=np.asarray(prompts[i], np.int32),
+                        response_tensor=r_ids[i, :l],
+                        logprobs=logprobs[i, :l],
+                        values=values[i, :l],
+                        rewards=rewards.astype(np.float32),
+                    )
+                )
+
+        self.mean_kl = float(np.mean(accumulated_kl))
+        rollout_time = self.clock.tick()
+        self.rollout_stats = {
+            "rollout_scores/mean": float(np.mean(all_scores_log)),
+            "rollout_scores/std": float(np.std(all_scores_log)),
+            "rollout_scores/running_mean": float(self.running_moments.mean),
+            "rollout_scores/running_std": float(self.running_moments.std),
+            "policy/sqrt_kl": float(np.sqrt(max(self.mean_kl, 0.0))),
+            "kl_ctl_value": float(self.kl_ctl.value),
+            "time/rollout_time": rollout_time,
+        }
+        if self.log_rollouts:
+            self.store.export_history(location=self.rollout_logging_dir, tokenizer=self.tokenizer)
+        self.push_to_store(ppo_rl_elements[:num_rollouts])
+
+    # ------------------------------------------------------------- train loop
+
+    def prepare_learning(self):
+        self.make_experience(self.method.num_rollouts, self.iter_count)
+        bs = self.config.train.batch_size
+        self.num_mb = max(1, bs // (self.config.train.minibatch_size or bs))
+
+    def create_train_dataloader(self):
+        """ppo_epochs passes over the current rollout store per outer epoch."""
+        loader = self.store.create_loader(
+            self.config.train.batch_size, shuffle=True, seed=self.config.train.seed + self.iter_count
+        )
+        for _ in range(self.method.ppo_epochs):
+            yield from loader
+
+    def _get_train_step(self, B: int, P: int, R: int):
+        key = (B, P, R)
+        if key in self._train_steps:
+            return self._train_steps[key]
+        module, method = self.module, self.method
+
+        def loss_fn(params, mb: PPORLBatch):
+            seq = jnp.concatenate([mb.query_tensors, mb.response_tensors], axis=1)
+            mask = jnp.concatenate([mb.attention_mask, mb.response_mask], axis=1)
+            logits, values_pred, _, _ = module.apply({"params": params}, seq, mask)
+            logprobs = logprobs_of_labels(logits[:, :-1], seq[:, 1:])
+            start = mb.query_tensors.shape[1] - 1
+            Rr = mb.response_tensors.shape[1]
+            logprobs = logprobs[:, start : start + Rr]
+            values_pred = values_pred[:, start : start + Rr].astype(jnp.float32)
+            advantages, returns = method.get_advantages_and_returns(
+                mb.values, mb.rewards, mb.response_mask
+            )
+            loss, stats = method.loss(
+                logprobs, values_pred, mb.logprobs, mb.values, advantages, returns,
+                mb.response_mask,
+            )
+            return loss, flatten_dict(stats)
+
+        self._train_steps[key] = self.make_grad_accum_step(loss_fn, self.num_mb)
+        return self._train_steps[key]
+
+    def train_step(self, batch: PPORLBatch) -> Dict[str, float]:
+        dbatch = mesh_lib.put_batch(self.mesh, batch)
+        step = self._get_train_step(
+            batch.query_tensors.shape[0], batch.query_tensors.shape[1], batch.response_tensors.shape[1]
+        )
+        with self.mesh:
+            self.params, self.opt_state, stats = step(self.params, self.opt_state, dbatch)
+        out = {k: float(v) for k, v in jax.device_get(stats).items()}
+        out.update(self.rollout_stats)
+        return out
+
+    def post_backward_callback(self):
+        """KL controller update per optimizer step (parity: :227-231)."""
+        self.kl_ctl.update(self.mean_kl, n_steps=self.config.train.batch_size)
+
+    def post_epoch_callback(self, epoch: int):
+        """Discard stale rollouts and collect fresh experience (parity: :219-225)."""
+        self.store.clear_history()
+        self.make_experience(self.method.num_rollouts, self.iter_count)

@@ -1,0 +1,116 @@
+"""SFT trainer (parity: `/root/reference/trlx/trainer/accelerate_sft_trainer.py:29-97`):
+supervised fine-tuning on strings or (prompt, output) dialogues with prompt-masked CE.
+"""
+
+from typing import Dict, List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.methods.sft import SFTConfig
+from trlx_tpu.models.hf_loading import load_pretrained
+from trlx_tpu.models.transformer import TransformerLM
+from trlx_tpu.ops.generation import pad_to_bucket
+from trlx_tpu.parallel import mesh as mesh_lib
+from trlx_tpu.parallel.sharding import make_param_shardings
+from trlx_tpu.pipeline.offline_pipeline import DialogStore, tokenize_dialogue
+from trlx_tpu.trainer import register_trainer
+from trlx_tpu.trainer.mesh_trainer import MeshRLTrainer
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+BUCKETS = [2 ** i for i in range(3, 14)]
+
+
+@register_trainer
+class SFTTrainer(MeshRLTrainer):
+    def __init__(self, config: TRLConfig, **kwargs):
+        super().__init__(config, **kwargs)
+        self.method: SFTConfig = config.method
+        self._train_steps = {}
+
+    def setup_model(self):
+        overrides = dict(self.config.model.model_overrides or {})
+        overrides.setdefault("param_dtype", self.param_dtype)
+        overrides.setdefault("compute_dtype", self.compute_dtype)
+        overrides.setdefault("remat", self.config.mesh.remat)
+        self.model_config, trunk_params, self.model_type = load_pretrained(
+            self.config.model.model_path, overrides
+        )
+        self.trunk_module = TransformerLM(self.model_config)
+        if trunk_params is None:
+            from trlx_tpu.models.hf_loading import init_params
+
+            trunk_params = init_params(self.model_config, self.trunk_module, self.config.train.seed)
+        params = {"transformer": trunk_params}
+        shardings = make_param_shardings(params, self.mesh)
+        self.params = jax.tree.map(
+            lambda x, s: jax.device_put(jnp.asarray(x, self.param_dtype), s), params, shardings
+        )
+
+    def gen_step_fn(self):
+        trunk = self.trunk_module
+
+        def step(params, ids, mask, positions, cache):
+            logits, hidden, _, cache = trunk.apply(
+                {"params": params["transformer"]}, ids, mask, positions, cache
+            )
+            return logits, hidden, cache
+
+        return step, lambda b, s: trunk.init_cache(b, s)
+
+    def make_experience(self, samples: List, seq_length: int):
+        """Tokenize dialogues into the DialogStore (parity: sft_trainer :60-70)."""
+        dialogs = [tokenize_dialogue(s, self.tokenizer, seq_length) for s in samples]
+        self.store = DialogStore(dialogs, self.tokenizer)
+
+    def create_train_dataloader(self):
+        return self.store.create_loader(
+            self.config.train.batch_size, shuffle=True, seed=self.config.train.seed
+        )
+
+    def prepare_learning(self):
+        bs = self.config.train.batch_size
+        self.num_mb = max(1, bs // (self.config.train.minibatch_size or bs))
+
+    def _get_train_step(self, B: int, T: int):
+        key = (B, T)
+        if key in self._train_steps:
+            return self._train_steps[key]
+        trunk, method = self.trunk_module, self.method
+
+        def loss_fn(params, mb):
+            logits, _, _, _ = trunk.apply(
+                {"params": params["transformer"]}, mb["input_ids"], mb["attention_mask"]
+            )
+            loss_mask = (mb["labels"] != DialogStore.IGNORE_INDEX).astype(jnp.float32)
+            labels = jnp.where(mb["labels"] == DialogStore.IGNORE_INDEX, 0, mb["labels"])
+            loss, stats = method.loss(logits, labels, loss_mask * mb["attention_mask"])
+            from trlx_tpu.utils.modeling import flatten_dict
+
+            return loss, flatten_dict(stats)
+
+        self._train_steps[key] = self.make_grad_accum_step(loss_fn, self.num_mb)
+        return self._train_steps[key]
+
+    def train_step(self, batch) -> Dict[str, float]:
+        B, T = batch["input_ids"].shape
+        Tb = pad_to_bucket(T, BUCKETS)
+        # pad rows to a num_mb multiple (fully-masked rows contribute zero loss)
+        Bp = ((B + self.num_mb - 1) // self.num_mb) * self.num_mb
+        pad = ((0, Bp - B), (0, Tb - T))
+        padded = {
+            "input_ids": np.pad(batch["input_ids"], pad, constant_values=self.tokenizer.pad_token_id),
+            "attention_mask": np.pad(batch["attention_mask"], pad),
+            "labels": np.pad(batch["labels"], pad, constant_values=DialogStore.IGNORE_INDEX),
+        }
+        B = Bp
+        dbatch = mesh_lib.put_batch(self.mesh, padded)
+        step = self._get_train_step(B, Tb)
+        with self.mesh:
+            self.params, self.opt_state, stats = step(self.params, self.opt_state, dbatch)
+        return {k: float(v) for k, v in jax.device_get(stats).items()}

@@ -1,0 +1,97 @@
+"""Public ``train()`` entry (parity: `/root/reference/trlx/trlx.py:15-143`): one
+function dispatching online RL (reward_fn → PPO/RFT), offline RL (samples+rewards →
+ILQL) and supervised fine-tuning (samples → SFT), building the trainer, pipelines and
+running ``learn()``."""
+
+from typing import Callable, Dict, Iterable, List, Optional, Tuple, Union
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import (
+    default_ilql_config,
+    default_ppo_config,
+    default_sft_config,
+)
+from trlx_tpu.utils import logging, set_seed
+from trlx_tpu.utils.loading import get_pipeline, get_trainer
+
+logger = logging.get_logger(__name__)
+
+
+def train(
+    model_path: Optional[str] = None,
+    reward_fn: Optional[Callable] = None,
+    dataset: Optional[Iterable[Tuple[str, float]]] = None,
+    samples: Optional[List[str]] = None,
+    rewards: Optional[List[float]] = None,
+    prompts: Optional[List[Union[str, Dict]]] = None,
+    eval_prompts: Optional[List[Union[str, Dict]]] = None,
+    metric_fn: Optional[Callable] = None,
+    config: Optional[TRLConfig] = None,
+    stop_sequences: Optional[List[str]] = None,
+):
+    """Dispatch & fit. See the reference docstring for argument semantics; the
+    surface is identical (model_path, reward_fn, samples, rewards, prompts,
+    eval_prompts, metric_fn, config, stop_sequences)."""
+    if config is None:
+        logger.warning("Passing the `config` argument implicitly is depreciated, use or adapt one of the default configs instead")
+        if reward_fn:
+            config = default_ppo_config()
+        elif rewards:
+            config = default_ilql_config()
+        else:
+            config = default_sft_config()
+    if model_path:
+        config.model.model_path = model_path
+
+    set_seed(config.train.seed)
+
+    if dataset is not None:
+        logger.warning("the `dataset` argument is being depreciated, split it into `samples` and `rewards` instead")
+        samples, rewards = dataset
+
+    trainer_cls = get_trainer(config.train.trainer)
+    trainer = trainer_cls(
+        config=config,
+        reward_fn=reward_fn,
+        metric_fn=metric_fn,
+        stop_sequences=stop_sequences,
+        **config.train.trainer_kwargs,
+    )
+
+    batch_size = config.train.batch_size
+    max_prompt_length = config.train.seq_length - config.method.gen_kwargs.get("max_new_tokens", 0)
+
+    # online RL (PPO / RFT): prompts + reward_fn
+    if reward_fn:
+        prompts = prompts or [trainer.tokenizer.bos_token] * batch_size
+        if eval_prompts is None:
+            eval_prompts = prompts[:batch_size]
+        pipeline = get_pipeline(config.train.pipeline)(
+            prompts, max_prompt_length, trainer.tokenizer
+        )
+        trainer.add_prompt_pipeline(pipeline)
+
+    # offline RL (ILQL): samples + rewards
+    elif samples is not None and rewards is not None:
+        if len(samples) != len(rewards):
+            raise ValueError(f"Number of samples {len(samples)} should match the number of rewards {len(rewards)}")
+        if eval_prompts is None:
+            eval_prompts = [trainer.tokenizer.bos_token] * batch_size
+        trainer.make_experience(samples, rewards, config.train.seq_length)
+
+    # supervised fine-tuning (SFT): samples only
+    elif samples is not None:
+        if eval_prompts is None:
+            eval_prompts = [trainer.tokenizer.bos_token] * batch_size
+        trainer.make_experience(samples, config.train.seq_length)
+
+    else:
+        raise ValueError("Either `samples` or `reward_fn` should be given for training")
+
+    eval_pipeline = get_pipeline(config.train.pipeline)(
+        eval_prompts, max_prompt_length, trainer.tokenizer
+    )
+    trainer.add_eval_pipeline(eval_pipeline)
+
+    trainer.learn()
+    return trainer

@@ -1,0 +1,22 @@
+"""Shared name→class registry factory used by the method / pipeline / trainer
+registries (the reference repeats this decorator three times; here it is one)."""
+
+from typing import Dict
+
+
+def make_registry(store: Dict[str, type]):
+    """Return a ``register`` decorator writing (lowercased name → class) into
+    ``store``. Accepts ``@register``, ``@register("name")``, or ``register(cls)``."""
+
+    def register(name_or_cls=None):
+        def _register(cls, name=None):
+            store[(name or cls.__name__).lower()] = cls
+            return cls
+
+        if isinstance(name_or_cls, str):
+            return lambda cls: _register(cls, name_or_cls)
+        if name_or_cls is None:
+            return _register
+        return _register(name_or_cls)
+
+    return register

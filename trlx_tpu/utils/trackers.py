@@ -1,0 +1,121 @@
+"""Experiment trackers: wandb / tensorboard / jsonl (offline default).
+
+Parity: the reference logs through ``accelerator.init_trackers``/``accelerator.log``
+(wandb or tensorboard, `accelerate_base_trainer.py:79-136,644`). Here trackers are a
+tiny strategy class; ``jsonl`` keeps full observability in zero-egress environments.
+Only process 0 logs (parity: rank-0 tracker init).
+"""
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+import jax
+
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+class BaseTracker:
+    def log(self, stats: Dict[str, Any], step: int):
+        pass
+
+    def log_table(self, name: str, columns, rows, step: int):
+        pass
+
+    def finish(self):
+        pass
+
+
+class JsonlTracker(BaseTracker):
+    def __init__(self, logging_dir: str, run_name: str, config: Optional[dict] = None):
+        os.makedirs(logging_dir, exist_ok=True)
+        self.path = os.path.join(logging_dir, f"{run_name}.jsonl")
+        self._f = open(self.path, "a")
+        if config is not None:
+            self._f.write(json.dumps({"_config": config, "_time": time.time()}) + "\n")
+
+    def log(self, stats, step):
+        rec = {"step": step, "_time": time.time()}
+        for k, v in stats.items():
+            try:
+                rec[k] = float(v)
+            except (TypeError, ValueError):
+                continue
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def log_table(self, name, columns, rows, step):
+        self._f.write(
+            json.dumps({"step": step, "_table": name, "columns": columns, "rows": rows[:32]})
+            + "\n"
+        )
+        self._f.flush()
+
+    def finish(self):
+        self._f.close()
+
+
+class TensorboardTracker(BaseTracker):
+    def __init__(self, logging_dir: str, run_name: str, config=None):
+        from torch.utils.tensorboard import SummaryWriter
+
+        self.writer = SummaryWriter(os.path.join(logging_dir, run_name))
+
+    def log(self, stats, step):
+        for k, v in stats.items():
+            try:
+                self.writer.add_scalar(k, float(v), step)
+            except (TypeError, ValueError):
+                continue
+
+    def finish(self):
+        self.writer.close()
+
+
+class WandbTracker(BaseTracker):
+    def __init__(self, project, entity, group, name, tags, config):
+        import wandb
+
+        self.run = wandb.init(
+            project=project, entity=entity, group=group, name=name, tags=tags,
+            config=config, reinit=True,
+        )
+        self.wandb = wandb
+
+    def log(self, stats, step):
+        self.run.log(dict(stats), step=step)
+
+    def log_table(self, name, columns, rows, step):
+        table = self.wandb.Table(columns=columns, rows=rows)
+        self.run.log({name: table}, step=step)
+
+    def finish(self):
+        self.run.finish()
+
+
+def make_tracker(train_config, full_config: dict) -> BaseTracker:
+    """Build the configured tracker on process 0; BaseTracker (no-op) elsewhere."""
+    if jax.process_index() != 0 or train_config.tracker is None:
+        return BaseTracker()
+    run_name = train_config.run_name or f"run-{int(time.time())}"
+    logging_dir = train_config.logging_dir or os.path.join(
+        train_config.checkpoint_dir, "logs"
+    )
+    kind = train_config.tracker
+    try:
+        if kind == "wandb":
+            return WandbTracker(
+                train_config.project_name, train_config.entity_name,
+                train_config.group_name, run_name, list(train_config.tags), full_config,
+            )
+        if kind == "tensorboard":
+            return TensorboardTracker(logging_dir, run_name, full_config)
+        if kind == "jsonl":
+            return JsonlTracker(logging_dir, run_name, full_config)
+    except Exception as e:  # tracker backends are optional; never kill training
+        logger.warning(f"Tracker {kind!r} unavailable ({e}); falling back to jsonl")
+        return JsonlTracker(logging_dir, run_name, full_config)
+    raise ValueError(f"Unknown tracker {kind!r}")
