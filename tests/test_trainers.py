@@ -125,3 +125,31 @@ def test_rft_end_to_end(tmp_path):
         config=config,
     )
     assert trainer.iter_count >= 1
+
+
+@pytest.mark.slow
+def test_ppo_seq2seq_end_to_end(tmp_path):
+    """T5 PPO path (parity: reference seq2seq PPO, ppo_sentiments_t5)."""
+    kwargs = base_kwargs(tmp_path, "PPOTrainer")
+    kwargs["model"] = ModelConfig(
+        model_path="t5", model_arch_type="seq2seq", num_layers_unfrozen=-1,
+        model_overrides=dict(
+            vocab_size=len(ALPHABET) + 3, d_model=32, d_kv=8, d_ff=64,
+            num_layers=2, num_decoder_layers=2, num_heads=4,
+            relative_attention_num_buckets=8, decoder_start_token_id=1,
+        ),
+    )
+    config = TRLConfig(
+        method=PPOConfig(
+            num_rollouts=8, chunk_size=4, ppo_epochs=2, init_kl_coef=0.01,
+            target=None, gen_kwargs=dict(max_new_tokens=6, do_sample=True, top_k=0, top_p=1.0),
+        ),
+        **kwargs,
+    )
+    trainer = trlx_tpu.train(
+        reward_fn=dog_reward,
+        prompts=["ab", "cd ef", "gh", "a b c"] * 2,
+        eval_prompts=["ab", "cd"],
+        config=config,
+    )
+    assert trainer.iter_count >= 3

@@ -148,3 +148,46 @@ def apply_hydra_branch(
         start_layer,
         method=module.forward_branch,
     )
+
+
+class Seq2SeqLMWithValueHead(nn.Module):
+    """T5-style seq2seq LM + scalar value head over decoder hidden states
+    (parity: ``AutoModelForSeq2SeqLMWithValueHead``, modeling_ppo.py:1242-1350)."""
+
+    config: "object"  # trlx_tpu.models.t5.T5Config
+
+    def setup(self):
+        from trlx_tpu.models.t5 import T5LM
+        from trlx_tpu.models.heads import MLPHead
+
+        self.t5 = T5LM(self.config)
+        self.v_head_mlp = MLPHead(_t5_head_cfg(self.config), out_dim=1)
+
+    def __call__(self, input_ids, attention_mask, decoder_input_ids, decoder_attention_mask=None):
+        logits, hidden, enc = self.t5(input_ids, attention_mask, decoder_input_ids, decoder_attention_mask)
+        values = self.v_head_mlp(hidden)[..., 0]
+        return logits, values, enc
+
+    def encode(self, input_ids, attention_mask):
+        return self.t5.encode(input_ids, attention_mask)
+
+    def precompute_cross_kv(self, enc_states):
+        return self.t5.precompute_cross_kv(enc_states)
+
+    def decode_step(self, decoder_input_ids, enc_states, encoder_attention_mask,
+                    decoder_attention_mask, positions, cache, cross_kvs):
+        logits, hidden, new_cache = self.t5.decode(
+            decoder_input_ids, enc_states, encoder_attention_mask,
+            decoder_attention_mask, positions, cache, cross_kvs,
+        )
+        return logits, hidden, new_cache
+
+
+def _t5_head_cfg(t5_config):
+    """Adapter so MLPHead (which reads hidden_size etc.) works on T5Config."""
+    from trlx_tpu.models.transformer import TransformerConfig
+
+    return TransformerConfig(
+        vocab_size=t5_config.vocab_size, hidden_size=t5_config.d_model,
+        param_dtype=t5_config.param_dtype, compute_dtype=t5_config.compute_dtype,
+    )

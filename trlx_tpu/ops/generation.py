@@ -155,3 +155,96 @@ def generate(
             [seqs[:, :P], jnp.where(response_mask > 0, resp, pad_token_id)], axis=1
         )
     return {"sequences": seqs, "response_mask": response_mask}
+
+
+def generate_seq2seq(
+    encode_fn,
+    cross_kv_fn,
+    decode_fn,
+    init_cache_fn,
+    params: Any,
+    input_ids: jnp.ndarray,
+    attention_mask: jnp.ndarray,
+    rng: jax.Array,
+    max_new_tokens: int,
+    decoder_start_token_id: int = 0,
+    eos_token_id: Optional[int] = None,
+    pad_token_id: int = 0,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    do_sample: bool = True,
+    min_new_tokens: int = 0,
+    logits_processor=None,
+) -> Dict[str, jnp.ndarray]:
+    """Seq2seq generation: encode once, precompute cross-attention K/V, then a
+    ``lax.while_loop`` decoder with a preallocated self-attention cache (replaces the
+    reference's HF seq2seq ``generate``; cf. modeling_ppo.py:1242-1350 usage).
+
+    ``decode_fn(params, tok[B,1], enc, enc_mask, dec_mask, positions, cache,
+    cross_kvs) -> (logits, hidden, cache)``. Returns ``sequences`` [B, 1+N] (leading
+    decoder_start token) and ``response_mask`` [B, N].
+    """
+    B = input_ids.shape[0]
+    N = int(max_new_tokens)
+
+    enc = encode_fn(params, input_ids, attention_mask)
+    cross_kvs = cross_kv_fn(params, enc)
+    cache = init_cache_fn(params, B, N + 1)
+
+    seqs = jnp.full((B, N + 1), pad_token_id, jnp.int32)
+    seqs = seqs.at[:, 0].set(decoder_start_token_id)
+    dec_mask = jnp.zeros((B, N + 1), jnp.int32).at[:, 0].set(1)
+
+    def sample_step(rng, step, logits, finished):
+        rng, sub = jax.random.split(rng)
+        if eos_token_id is not None and min_new_tokens > 0:
+            logits = jnp.where(
+                (step < min_new_tokens)
+                & (jnp.arange(logits.shape[-1]) == eos_token_id)[None, :],
+                -1e9,
+                logits,
+            )
+        tok = sample_token(sub, logits, temperature, top_k, top_p, do_sample)
+        return rng, jnp.where(finished, pad_token_id, tok)
+
+    def cond(state):
+        step, _, _, finished, _, _, _ = state
+        return jnp.logical_and(step < N, jnp.logical_not(jnp.all(finished)))
+
+    def body(state):
+        step, seqs, dec_mask, finished, cache, rng, tok = state
+        logits, hidden, cache = decode_fn(
+            params, tok[:, None], enc, attention_mask, dec_mask, None, cache, cross_kvs
+        )
+        step_logits = logits[:, -1, :]
+        if logits_processor is not None:
+            step_logits = logits_processor(params, hidden[:, -1, :], step_logits)
+        rng, new_tok = sample_step(rng, step, step_logits, finished)
+        new_finished = finished
+        if eos_token_id is not None:
+            new_finished = jnp.logical_or(finished, new_tok == eos_token_id)
+        seqs = jax.lax.dynamic_update_slice(seqs, new_tok[:, None], (0, step + 1))
+        dec_mask = jax.lax.dynamic_update_slice(
+            dec_mask, jnp.ones((B, 1), jnp.int32), (0, step + 1)
+        )
+        return step + 1, seqs, dec_mask, new_finished, cache, rng, new_tok
+
+    tok0 = jnp.full((B,), decoder_start_token_id, jnp.int32)
+    state = (
+        jnp.array(0, jnp.int32), seqs, dec_mask, jnp.zeros((B,), bool), cache, rng, tok0
+    )
+    step, seqs, dec_mask, finished, cache, rng, tok = jax.lax.while_loop(cond, body, state)
+
+    response_mask = dec_mask[:, 1:]
+    if eos_token_id is not None:
+        resp = seqs[:, 1:]
+        is_eos = resp == eos_token_id
+        after_eos = jnp.cumsum(jnp.pad(is_eos[:, :-1], ((0, 0), (1, 0))), axis=1) > 0
+        response_mask = response_mask * (1 - after_eos.astype(jnp.int32))
+        written = jnp.arange(N)[None, :] < step
+        response_mask = response_mask * written.astype(jnp.int32)
+        seqs = jnp.concatenate(
+            [seqs[:, :1], jnp.where(response_mask > 0, resp, pad_token_id)], axis=1
+        )
+    return {"sequences": seqs, "response_mask": response_mask}

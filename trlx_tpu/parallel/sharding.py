@@ -47,6 +47,12 @@ def default_lm_rules() -> List[Rule]:
         (r".*down_proj/kernel$", PartitionSpec(MODEL_AXIS, FSDP_AXIS)),
         # lm head: [hidden, vocab] — vocab over model
         (r".*lm_head/kernel$", PartitionSpec(FSDP_AXIS, MODEL_AXIS)),
+        # T5: shared embedding, q/k/v column-parallel, o row-parallel, wi/wo mlp
+        (r".*shared/embedding$", PartitionSpec(MODEL_AXIS, FSDP_AXIS)),
+        (r".*/(q|k|v)/kernel$", PartitionSpec(FSDP_AXIS, MODEL_AXIS)),
+        (r".*/o/kernel$", PartitionSpec(MODEL_AXIS, FSDP_AXIS)),
+        (r".*/(wi|wi_0|wi_1)/kernel$", PartitionSpec(FSDP_AXIS, MODEL_AXIS)),
+        (r".*/wo/kernel$", PartitionSpec(MODEL_AXIS, FSDP_AXIS)),
         # value / Q heads: small MLPs, shard hidden over fsdp only
         (r".*(value_head|q_head|target_q_head|v_head).*/kernel$", PartitionSpec(FSDP_AXIS, None)),
         # everything else (norms, biases, scalars): replicated

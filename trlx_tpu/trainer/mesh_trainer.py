@@ -26,7 +26,7 @@ import optax
 
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.ops.generation import generate as generate_op
-from trlx_tpu.ops.generation import left_pad_batch, pad_to_bucket
+from trlx_tpu.ops.generation import generate_seq2seq, left_pad_batch, pad_to_bucket
 from trlx_tpu.parallel import mesh as mesh_lib
 from trlx_tpu.parallel.sharding import make_param_shardings, shard_params
 from trlx_tpu.pipeline.tokenization import load_tokenizer
@@ -198,28 +198,44 @@ class MeshRLTrainer(BaseRLTrainer):
         P = pad_to_bucket(max_len, buckets)
         ids, mask = left_pad_batch(prompts_ids, gen_kwargs["pad_token_id"], P)
 
-        key = (ids.shape, max_new, tuple(sorted(gen_kwargs.items())))
+        is_seq2seq = getattr(self, "is_seq2seq", False)
+        key = (ids.shape, max_new, is_seq2seq, tuple(sorted(gen_kwargs.items())))
         if key not in self._compiled_generate:
-            step_fn, init_cache_fn = self.gen_step_fn()
-            fn = partial(
-                generate_op,
-                step_fn,
-                init_cache_fn=init_cache_fn,
-                max_new_tokens=max_new,
-                logits_processor=self.gen_logits_processor(),
-                **gen_kwargs,
-            )
-            self._compiled_generate[key] = jax.jit(
-                lambda params, i, m, r: fn(params, input_ids=i, attention_mask=m, rng=r)
-            )
+            if is_seq2seq:
+                fns = self.seq2seq_gen_fns()
+                fn = partial(
+                    generate_seq2seq,
+                    fns["encode"], fns["cross_kv"], fns["decode"], fns["init_cache"],
+                    max_new_tokens=max_new,
+                    decoder_start_token_id=self.decoder_start_token_id,
+                    logits_processor=self.gen_logits_processor(),
+                    **gen_kwargs,
+                )
+                self._compiled_generate[key] = jax.jit(
+                    lambda params, i, m, r: fn(params=params, input_ids=i, attention_mask=m, rng=r)
+                )
+            else:
+                step_fn, init_cache_fn = self.gen_step_fn()
+                fn = partial(
+                    generate_op,
+                    step_fn,
+                    init_cache_fn=init_cache_fn,
+                    max_new_tokens=max_new,
+                    logits_processor=self.gen_logits_processor(),
+                    **gen_kwargs,
+                )
+                self._compiled_generate[key] = jax.jit(
+                    lambda params, i, m, r: fn(params, input_ids=i, attention_mask=m, rng=r)
+                )
         self.rng, sub = jax.random.split(self.rng)
         batch = mesh_lib.put_batch(self.mesh, {"ids": ids, "mask": mask})
         with self.mesh:
             out = self._compiled_generate[key](self.params, batch["ids"], batch["mask"], sub)
+        # seq2seq sequences are [decoder_start] + response: pad_len for decode() is 1
         return (
             np.asarray(jax.device_get(out["sequences"])),
             np.asarray(jax.device_get(out["response_mask"])),
-            P,
+            1 if is_seq2seq else P,
         )
 
     def decode(

@@ -65,10 +65,16 @@ class PPOTrainer(MeshRLTrainer):
     def setup_model(self):
         """Build policy+value model; reference model is either the hydra frozen
         top-branch (num_layers_unfrozen > 0) or a full frozen param copy
-        (parity: get_arch + ref_model setup, accelerate_ppo_trainer.py:65-108)."""
+        (parity: get_arch + ref_model setup, accelerate_ppo_trainer.py:65-108).
+        ``model_arch_type == "seq2seq"`` selects the T5 path (parity:
+        modeling_ppo.py:1242-1350)."""
+        self.is_seq2seq = self.config.model.model_arch_type == "seq2seq"
         overrides = dict(self.config.model.model_overrides or {})
         overrides.setdefault("param_dtype", self.param_dtype)
         overrides.setdefault("compute_dtype", self.compute_dtype)
+        if self.is_seq2seq:
+            self._setup_seq2seq_model(overrides)
+            return
         overrides.setdefault("remat", self.config.mesh.remat)
         self.model_config, trunk_params, self.model_type = load_pretrained(
             self.config.model.model_path, overrides
@@ -108,7 +114,74 @@ class PPOTrainer(MeshRLTrainer):
             self.frozen_branch_params = None
             self.ref_params = device_copy(self.params["transformer"])
 
+    def _setup_seq2seq_model(self, overrides):
+        from trlx_tpu.models.hf_loading import load_pretrained_seq2seq
+        from trlx_tpu.models.policy import Seq2SeqLMWithValueHead
+
+        self.model_config, t5_params = load_pretrained_seq2seq(
+            self.config.model.model_path, overrides
+        )
+        self.model_type = "t5"
+        self.decoder_start_token_id = self.model_config.decoder_start_token_id
+        self.module = Seq2SeqLMWithValueHead(self.model_config)
+        params = self.module.init(
+            jax.random.PRNGKey(self.config.train.seed),
+            jnp.ones((1, 4), jnp.int32), jnp.ones((1, 4), jnp.int32),
+            jnp.zeros((1, 2), jnp.int32),
+        )["params"]
+        if t5_params is not None:
+            params = dict(params)
+            params["t5"] = t5_params
+        shardings = make_param_shardings(params, self.mesh)
+        self.params = jax.tree.map(
+            lambda x, s: jax.device_put(jnp.asarray(x, self.param_dtype), s), params, shardings
+        )
+
+        # seq2seq reference model: full frozen copy of the T5 trunk (the reference's
+        # T5Branch decoder-top variant is a possible later optimization)
+        def device_copy(tree):
+            with self.mesh:
+                return jax.jit(lambda t: jax.tree.map(lambda x: x.copy(), t))(tree)
+
+        self.branch_start = None
+        self.frozen_branch_params = None
+        self.ref_params = device_copy(self.params["t5"])
+
+    def trainable_path_predicate(self, path: str) -> bool:
+        if getattr(self, "is_seq2seq", False):
+            n_unfrozen = self.config.model.num_layers_unfrozen
+            if n_unfrozen < 0 or "t5" not in path:
+                return True
+            # freeze encoder + bottom decoder blocks; top-N decoder blocks + heads train
+            if "decoder_blocks_" in path:
+                layer = int(path.split("decoder_blocks_")[1].split("/")[0])
+                return layer >= self.model_config.num_decoder_layers - n_unfrozen
+            return "decoder_ln" in path
+        return super().trainable_path_predicate(path)
+
     # ------------------------------------------------------------- generation
+
+    def seq2seq_gen_fns(self):
+        module = self.module
+
+        return {
+            "encode": lambda params, ids, mask: module.apply(
+                {"params": params}, ids, mask, method=module.encode
+            ),
+            "cross_kv": lambda params, enc: module.apply(
+                {"params": params}, enc, method=module.precompute_cross_kv
+            ),
+            "decode": lambda params, tok, enc, enc_mask, dec_mask, pos, cache, ckv: module.apply(
+                {"params": params}, tok, enc, enc_mask, dec_mask, pos, cache, ckv,
+                method=module.decode_step,
+            ),
+            "init_cache": lambda params, b, n: self._t5_module().init_cache(b, n),
+        }
+
+    def _t5_module(self):
+        from trlx_tpu.models.t5 import T5LM
+
+        return T5LM(self.model_config)
 
     def gen_step_fn(self):
         trunk = self.trunk_module
@@ -148,6 +221,27 @@ class PPOTrainer(MeshRLTrainer):
         the response window (parity: :414-446). One compile per (B, P, R)."""
         key = (B, P, R)
         if key in self._score_fns:
+            return self._score_fns[key]
+
+        if self.is_seq2seq:
+            module, t5 = self.module, self._t5_module()
+            start_tok = self.decoder_start_token_id
+
+            def score_s2s(params, ref_params, q_ids, q_mask, r_ids, r_mask):
+                Bs = q_ids.shape[0]
+                dec_in = jnp.concatenate(
+                    [jnp.full((Bs, 1), start_tok, jnp.int32), r_ids[:, :-1]], axis=1
+                )
+                dec_mask = jnp.concatenate(
+                    [jnp.ones((Bs, 1), jnp.int32), r_mask[:, :-1]], axis=1
+                )
+                logits, values, _ = module.apply({"params": params}, q_ids, q_mask, dec_in, dec_mask)
+                logprobs = logprobs_of_labels(logits, r_ids)
+                ref_logits, _, _ = t5.apply({"params": ref_params}, q_ids, q_mask, dec_in, dec_mask)
+                ref_logprobs = logprobs_of_labels(ref_logits, r_ids)
+                return logprobs, values.astype(jnp.float32), ref_logprobs
+
+            self._score_fns[key] = jax.jit(score_s2s)
             return self._score_fns[key]
 
         module, trunk = self.module, self.trunk_module
@@ -231,16 +325,25 @@ class PPOTrainer(MeshRLTrainer):
             for i, o in enumerate(out_ids):
                 r_ids[i, : len(o)] = o
                 r_mask[i, : len(o)] = 1
-            seq = np.concatenate([q_ids, r_ids], axis=1)
-            mask = np.concatenate([q_mask, r_mask], axis=1)
-
-            dbatch = mesh_lib.put_batch(self.mesh, {"seq": seq, "mask": mask})
-            score_fn = self._get_score_fn(seq.shape[0], P, R)
-            with self.mesh:
-                logprobs, values, ref_logprobs = score_fn(
-                    self.params, self.ref_params, self.frozen_branch_params,
-                    dbatch["seq"], dbatch["mask"],
+            score_fn = self._get_score_fn(q_ids.shape[0], P, R)
+            if self.is_seq2seq:
+                dbatch = mesh_lib.put_batch(
+                    self.mesh, {"q": q_ids, "qm": q_mask, "r": r_ids, "rm": r_mask}
                 )
+                with self.mesh:
+                    logprobs, values, ref_logprobs = score_fn(
+                        self.params, self.ref_params,
+                        dbatch["q"], dbatch["qm"], dbatch["r"], dbatch["rm"],
+                    )
+            else:
+                seq = np.concatenate([q_ids, r_ids], axis=1)
+                mask = np.concatenate([q_mask, r_mask], axis=1)
+                dbatch = mesh_lib.put_batch(self.mesh, {"seq": seq, "mask": mask})
+                with self.mesh:
+                    logprobs, values, ref_logprobs = score_fn(
+                        self.params, self.ref_params, self.frozen_branch_params,
+                        dbatch["seq"], dbatch["mask"],
+                    )
             logprobs = np.asarray(jax.device_get(logprobs))
             values = np.asarray(jax.device_get(values))
             ref_logprobs = np.asarray(jax.device_get(ref_logprobs))
@@ -305,6 +408,34 @@ class PPOTrainer(MeshRLTrainer):
         if key in self._train_steps:
             return self._train_steps[key]
         module, method = self.module, self.method
+
+        if self.is_seq2seq:
+            start_tok = self.decoder_start_token_id
+
+            def loss_fn_s2s(params, mb: PPORLBatch):
+                Bs = mb.response_tensors.shape[0]
+                dec_in = jnp.concatenate(
+                    [jnp.full((Bs, 1), start_tok, jnp.int32), mb.response_tensors[:, :-1]], axis=1
+                )
+                dec_mask = jnp.concatenate(
+                    [jnp.ones((Bs, 1), jnp.int32), mb.response_mask[:, :-1]], axis=1
+                )
+                logits, values_pred, _ = module.apply(
+                    {"params": params}, mb.query_tensors, mb.attention_mask, dec_in, dec_mask
+                )
+                logprobs = logprobs_of_labels(logits, mb.response_tensors)
+                values_pred = values_pred.astype(jnp.float32)
+                advantages, returns = method.get_advantages_and_returns(
+                    mb.values, mb.rewards, mb.response_mask
+                )
+                loss, stats = method.loss(
+                    logprobs, values_pred, mb.logprobs, mb.values, advantages, returns,
+                    mb.response_mask,
+                )
+                return loss, flatten_dict(stats)
+
+            self._train_steps[key] = self.make_grad_accum_step(loss_fn_s2s, self.num_mb)
+            return self._train_steps[key]
 
         def loss_fn(params, mb: PPORLBatch):
             seq = jnp.concatenate([mb.query_tensors, mb.response_tensors], axis=1)
