@@ -1,0 +1,93 @@
+"""Native LoRA tests (strategy mirrors reference tests/test_peft.py: adapters start
+as no-ops, backprop only touches adapter+head params, merged export equals adapter
+forward, hydra reference equals the base model)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.presets import PRESETS
+from trlx_tpu.models.transformer import TransformerLM, merge_lora_params
+from trlx_tpu.utils.modeling import flatten_dict
+
+TINY = dict(
+    vocab_size=32, hidden_size=16, num_layers=2, num_heads=2,
+    max_position_embeddings=32, compute_dtype=jnp.float32,
+)
+
+
+def make(r=4):
+    config = PRESETS["gpt2"].replace(**TINY, lora_r=r, lora_alpha=8.0)
+    model = TransformerLM(config)
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (2, 6), 1, 32)
+    params = model.init(rng, ids, jnp.ones_like(ids))["params"]
+    return config, model, params, ids
+
+
+def test_lora_starts_as_noop():
+    config, model, params, ids = make(r=4)
+    base_model = TransformerLM(config.replace(lora_r=0))
+    base_params = jax.tree.map(lambda x: x, params)
+    # strip lora leaves for the base apply
+    flat = flatten_dict(params)
+    assert any("lora_a" in k for k in flat), "lora params must exist"
+    logits_lora, *_ = model.apply({"params": params}, ids, jnp.ones_like(ids))
+    logits_base, *_ = base_model.apply({"params": base_params}, ids, jnp.ones_like(ids))
+    np.testing.assert_allclose(np.asarray(logits_lora), np.asarray(logits_base), atol=1e-6)
+
+
+def test_lora_grads_only_touch_adapters():
+    config, model, params, ids = make(r=4)
+
+    def loss(p):
+        logits, *_ = model.apply({"params": p}, ids, jnp.ones_like(ids))
+        return jnp.sum(logits**2)
+
+    grads = jax.grad(loss)(params)
+    flat = flatten_dict(grads)
+    # lora_b receives gradient even at init (lora_a output is nonzero)
+    lora_b_grads = sum(np.abs(np.asarray(v)).sum() for k, v in flat.items() if "lora_b" in k)
+    assert lora_b_grads > 0
+    # the trainable-mask predicate is what the trainers use; verify it selects only
+    # adapters + heads when peft_config is set
+    from trlx_tpu.data.configs import MeshConfig, ModelConfig
+
+    class FakeTrainer:
+        from trlx_tpu.trainer.mesh_trainer import MeshRLTrainer as _M
+
+        config = type("C", (), {"model": ModelConfig(peft_config={"r": 4})})()
+        model_config = config.model
+        trainable_path_predicate = _M.trainable_path_predicate
+
+    t = FakeTrainer()
+    assert t.trainable_path_predicate("transformer/layers_0/attn/q_proj/lora_a")
+    assert not t.trainable_path_predicate("transformer/layers_0/attn/q_proj/kernel")
+    assert t.trainable_path_predicate("v_head/value_head/fc_in/kernel")
+
+
+def test_lora_merge_matches_adapter_forward():
+    config, model, params, ids = make(r=4)
+    # make adapters non-trivial
+    rng = jax.random.PRNGKey(7)
+
+    def bump(tree, path=""):
+        if isinstance(tree, dict):
+            return {k: bump(v, path + "/" + k) for k, v in tree.items()}
+        if "lora_b" in path:
+            return jax.random.normal(jax.random.fold_in(rng, len(path)), tree.shape) * 0.1
+        return tree
+
+    params = bump(params)
+    logits_adapter, *_ = model.apply({"params": params}, ids, jnp.ones_like(ids))
+
+    merged = merge_lora_params(jax.device_get(params), config)
+    base_model = TransformerLM(config.replace(lora_r=0))
+    logits_merged, *_ = base_model.apply({"params": merged}, ids, jnp.ones_like(ids))
+    np.testing.assert_allclose(
+        np.asarray(logits_adapter), np.asarray(logits_merged), atol=1e-4, rtol=1e-4
+    )
+    flat = flatten_dict(merged)
+    assert not any("lora_" in k for k in flat)

@@ -558,3 +558,38 @@ def load_pretrained_seq2seq(model_path: str, overrides: Optional[Dict[str, Any]]
         f"No local checkpoint at {model_path!r}; using random-init T5 config (zero-egress)"
     )
     return config, None
+
+
+def merge_loaded_params(init_tree: Dict[str, Any], loaded_tree: Dict[str, Any]) -> Dict[str, Any]:
+    """Overlay checkpoint leaves onto an init tree, keeping init-only params (LoRA
+    adapters, new heads) — the JAX analogue of HF's lenient state-dict load."""
+    if not isinstance(init_tree, dict):
+        return loaded_tree if loaded_tree is not None else init_tree
+    out = {}
+    for k, v in init_tree.items():
+        if isinstance(loaded_tree, dict) and k in loaded_tree:
+            out[k] = merge_loaded_params(v, loaded_tree[k])
+        else:
+            out[k] = v
+    # keep any loaded-only keys too (e.g. optional biases)
+    if isinstance(loaded_tree, dict):
+        for k, v in loaded_tree.items():
+            if k not in out:
+                out[k] = v
+    return out
+
+
+def peft_overrides(peft_config: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Map a reference-style peft/LoRA config dict to TransformerConfig overrides
+    (parity: modeling_base.py:162-240; only LoRA is supported natively)."""
+    if not peft_config:
+        return {}
+    ptype = str(peft_config.get("peft_type", "LORA")).upper()
+    if ptype != "LORA":
+        raise ValueError(f"Only LoRA peft is supported natively (got {ptype!r})")
+    out = {"lora_r": int(peft_config.get("r", 8)),
+           "lora_alpha": float(peft_config.get("lora_alpha", peft_config.get("alpha", 16)))}
+    targets = peft_config.get("target_modules")
+    if targets:
+        out["lora_targets"] = tuple(targets)
+    return out

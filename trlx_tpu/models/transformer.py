@@ -67,6 +67,12 @@ class TransformerConfig:
     remat: str = "none"  # "none" | "full" | "nothing_saveable" | "dots_saveable"
     attention_impl: str = "xla"  # "xla" | "flash" (Pallas kernel for prefill/training)
 
+    # LoRA adapters (native peft equivalent; reference uses the peft library —
+    # modeling_base.py:162-240). r=0 disables.
+    lora_r: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: Tuple[str, ...] = ("q_proj", "v_proj")
+
     @property
     def kv_heads(self) -> int:
         return self.num_kv_heads or self.num_heads
@@ -134,6 +140,62 @@ def apply_rotary(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray, style: str)
     return jnp.concatenate([rotated, x_pass], axis=-1).astype(x.dtype)
 
 
+class LoraDense(nn.Module):
+    """Dense with the same param layout as nn.Dense (``kernel``/``bias``) plus
+    optional low-rank adapters ``lora_a``/``lora_b`` (y += x A B * alpha/r).
+    ``lora_a`` is normal-initialized, ``lora_b`` zeros, so the adapter starts as a
+    no-op — the LoRA convention."""
+
+    features: int
+    use_bias: bool
+    dtype: Any
+    param_dtype: Any
+    kernel_init: Any
+    r: int = 0
+    alpha: float = 16.0
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        kernel = self.param("kernel", self.kernel_init, (in_features, self.features), self.param_dtype)
+        y = x.astype(self.dtype) @ kernel.astype(self.dtype)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.features,), self.param_dtype)
+            y = y + bias.astype(self.dtype)
+        if self.r > 0:
+            a = self.param(
+                "lora_a", nn.initializers.normal(1.0 / self.r), (in_features, self.r), self.param_dtype
+            )
+            b = self.param("lora_b", nn.initializers.zeros, (self.r, self.features), self.param_dtype)
+            y = y + (x.astype(self.dtype) @ a.astype(self.dtype)) @ b.astype(self.dtype) * (
+                self.alpha / self.r
+            )
+        return y
+
+
+def merge_lora_params(params: Dict[str, Any], config: "TransformerConfig") -> Dict[str, Any]:
+    """Fold adapters into base kernels (W += A B * alpha/r) and drop lora leaves —
+    used when exporting to HF format (parity: peft ``merge_and_unload``)."""
+    import numpy as np
+
+    def walk(tree):
+        if not isinstance(tree, dict):
+            return tree
+        out = {}
+        if "kernel" in tree and "lora_a" in tree:
+            scale = config.lora_alpha / config.lora_r
+            out["kernel"] = np.asarray(tree["kernel"]) + np.asarray(tree["lora_a"]) @ np.asarray(
+                tree["lora_b"]
+            ) * scale
+            for k, v in tree.items():
+                if k not in ("kernel", "lora_a", "lora_b"):
+                    out[k] = walk(v)
+            return out
+        return {k: walk(v) for k, v in tree.items()}
+
+    return walk(params)
+
+
 class Attention(nn.Module):
     config: TransformerConfig
 
@@ -151,9 +213,10 @@ class Attention(nn.Module):
         Pallas flash path (no-cache forward only)."""
         c = self.config
         B, T, _ = x.shape
-        dense = lambda feats, name, bias: nn.Dense(
+        dense = lambda feats, name, bias: LoraDense(
             feats, use_bias=bias, dtype=c.compute_dtype, param_dtype=c.param_dtype,
             kernel_init=nn.initializers.normal(c.initializer_range), name=name,
+            r=c.lora_r if name in c.lora_targets else 0, alpha=c.lora_alpha,
         )
         q = dense(c.num_heads * c.dim_per_head, "q_proj", c.attn_bias)(x)
         k = dense(c.kv_heads * c.dim_per_head, "k_proj", c.attn_bias)(x)
@@ -216,9 +279,10 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         c = self.config
-        dense = lambda feats, name: nn.Dense(
+        dense = lambda feats, name: LoraDense(
             feats, use_bias=c.mlp_bias, dtype=c.compute_dtype, param_dtype=c.param_dtype,
             kernel_init=nn.initializers.normal(c.initializer_range), name=name,
+            r=c.lora_r if name in c.lora_targets else 0, alpha=c.lora_alpha,
         )
         act = _act(c.activation)
         if c.glu:

@@ -89,6 +89,9 @@ class ILQLTrainer(MeshRLTrainer):
         overrides.setdefault("param_dtype", self.param_dtype)
         overrides.setdefault("compute_dtype", self.compute_dtype)
         overrides.setdefault("remat", self.config.mesh.remat)
+        from trlx_tpu.models.hf_loading import merge_loaded_params, peft_overrides
+
+        overrides.update(peft_overrides(self.config.model.peft_config))
         self.model_config, trunk_params, self.model_type = load_pretrained(
             self.config.model.model_path, overrides
         )
@@ -102,7 +105,7 @@ class ILQLTrainer(MeshRLTrainer):
         )["params"]
         if trunk_params is not None:
             params = dict(params)
-            params["transformer"] = trunk_params
+            params["transformer"] = merge_loaded_params(params["transformer"], trunk_params)
         # start target heads equal to online heads (parity: ILQLHeads init sync)
         params["ilql_heads"] = _sync_heads(dict(params["ilql_heads"]), alpha=1.0)
         shardings = make_param_shardings(params, self.mesh)

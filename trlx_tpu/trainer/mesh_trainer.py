@@ -95,6 +95,9 @@ class MeshRLTrainer(BaseRLTrainer):
         """Which params receive gradients (parity: ``freeze_bottom_causal_layers``,
         reference utils/modeling.py:22-45): with num_layers_unfrozen = N > 0, only
         the top N transformer layers and all heads train; -1 trains everything."""
+        if self.config.model.peft_config:
+            # LoRA mode: only adapters and heads receive gradients
+            return "lora_" in path or ("transformer" not in path and "t5" not in path)
         n_unfrozen = self.config.model.num_layers_unfrozen
         if n_unfrozen < 0:
             return True
@@ -454,6 +457,10 @@ class MeshRLTrainer(BaseRLTrainer):
 
         params = jax.device_get(self.params)
         trunk = params.get("transformer", params)
+        if getattr(self.model_config, "lora_r", 0):
+            from trlx_tpu.models.transformer import merge_lora_params
+
+            trunk = merge_lora_params(trunk, self.model_config)
         os.makedirs(directory, exist_ok=True)
         if jax.process_index() == 0:
             try:

@@ -76,6 +76,9 @@ class PPOTrainer(MeshRLTrainer):
             self._setup_seq2seq_model(overrides)
             return
         overrides.setdefault("remat", self.config.mesh.remat)
+        from trlx_tpu.models.hf_loading import merge_loaded_params, peft_overrides
+
+        overrides.update(peft_overrides(self.config.model.peft_config))
         self.model_config, trunk_params, self.model_type = load_pretrained(
             self.config.model.model_path, overrides
         )
@@ -89,7 +92,7 @@ class PPOTrainer(MeshRLTrainer):
         )["params"]
         if trunk_params is not None:
             params = dict(params)
-            params["transformer"] = trunk_params
+            params["transformer"] = merge_loaded_params(params["transformer"], trunk_params)
 
         shardings = make_param_shardings(params, self.mesh)
         self.params = jax.tree.map(

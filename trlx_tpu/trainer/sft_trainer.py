@@ -38,15 +38,17 @@ class SFTTrainer(MeshRLTrainer):
         overrides.setdefault("param_dtype", self.param_dtype)
         overrides.setdefault("compute_dtype", self.compute_dtype)
         overrides.setdefault("remat", self.config.mesh.remat)
+        from trlx_tpu.models.hf_loading import init_params, merge_loaded_params, peft_overrides
+
+        overrides.update(peft_overrides(self.config.model.peft_config))
         self.model_config, trunk_params, self.model_type = load_pretrained(
             self.config.model.model_path, overrides
         )
         self.trunk_module = TransformerLM(self.model_config)
-        if trunk_params is None:
-            from trlx_tpu.models.hf_loading import init_params
-
-            trunk_params = init_params(self.model_config, self.trunk_module, self.config.train.seed)
-        params = {"transformer": trunk_params}
+        init_tree = init_params(self.model_config, self.trunk_module, self.config.train.seed)
+        if trunk_params is not None:
+            init_tree = merge_loaded_params(init_tree, trunk_params)
+        params = {"transformer": init_tree}
         shardings = make_param_shardings(params, self.mesh)
         self.params = jax.tree.map(
             lambda x, s: jax.device_put(jnp.asarray(x, self.param_dtype), s), params, shardings
