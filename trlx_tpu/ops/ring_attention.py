@@ -1,0 +1,101 @@
+"""Ring attention: sequence-parallel causal attention over a mesh axis.
+
+The reference has NO context parallelism — its only sequence-dim scaling is Megatron
+SP activation sharding (SURVEY.md §2.3 row CP: "absent — gap to fill natively").
+This implements blockwise ring attention (cf. Liu et al., Ring Attention; the
+scaling-book collective recipe): Q/K/V are sharded along the sequence dimension
+across a mesh axis; each step every device computes a flash-style online-softmax
+block against its current K/V shard, then rotates K/V one hop around the ring with
+``jax.lax.ppermute`` over ICI. Peak memory per chip is O(S_local), enabling sequences
+far beyond a single chip's HBM.
+
+Causal structure at shard granularity: after ``step`` rotations device ``i`` holds
+the K/V shard originally on device ``(i - step) mod n``; it contributes fully when
+source < i, diagonally (within-shard causal) when source == i, and is skipped when
+source > i.
+"""
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, m, l, acc, scale, mask):
+    """One online-softmax accumulation step.
+
+    q [B,H,Tq,D]; k/v [B,H,Tk,D]; m/l [B,H,Tq,1]; acc [B,H,Tq,D];
+    mask [Tq,Tk] bool or None (True = attend)."""
+    s = jnp.einsum("bhtd,bhsd->bhts", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, m_cur)
+    p = jnp.where(m_new > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
+    alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+    l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum("bhts,bhsd->bhtd", p, v.astype(jnp.float32))
+    return m_new, l_new, acc_new
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis_name: str = "model",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Sequence-parallel attention. q/k/v: [B, H, S, D] with S sharded over
+    ``axis_name`` (batch/head dims replicated or sharded elsewhere). Returns the
+    attention output with the same sharding as q."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    n = mesh.shape[axis_name]
+
+    def local_fn(q_loc, k_loc, v_loc):
+        B, H, T, D = q_loc.shape
+        my = jax.lax.axis_index(axis_name)
+        tri = jnp.tril(jnp.ones((T, T), dtype=bool))
+
+        def body(step, carry):
+            k_cur, v_cur, m, l, acc = carry
+            src = (my - step) % n
+            # contribution mask at shard granularity
+            full = src < my
+            diag = src == my
+            m2, l2, acc2 = _block_attn(
+                q_loc, k_cur, v_cur, m, l, acc, scale,
+                mask=tri if causal else None,
+            )
+            mf, lf, accf = _block_attn(q_loc, k_cur, v_cur, m, l, acc, scale, mask=None)
+            if causal:
+                use_diag = diag
+                use_full = full
+                m_new = jnp.where(use_diag, m2, jnp.where(use_full, mf, m))
+                l_new = jnp.where(use_diag, l2, jnp.where(use_full, lf, l))
+                acc_new = jnp.where(use_diag, acc2, jnp.where(use_full, accf, acc))
+            else:
+                m_new, l_new, acc_new = mf, lf, accf
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+            return (k_next, v_next, m_new, l_new, acc_new)
+
+        m0 = jnp.full((B, H, T, 1), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((B, H, T, 1), jnp.float32)
+        acc0 = jnp.zeros((B, H, T, D), jnp.float32)
+        _, _, m, l, acc = jax.lax.fori_loop(0, n, body, (k_loc, v_loc, m0, l0, acc0))
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        return (acc / safe_l).astype(q_loc.dtype)
+
+    spec = P(None, None, axis_name, None)
+    return shard_map(
+        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_rep=False
+    )(q, k, v)
