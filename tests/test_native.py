@@ -1,0 +1,54 @@
+"""Native data plane: C++ kernels must agree exactly with the numpy fallbacks."""
+
+import numpy as np
+import pytest
+
+import trlx_tpu.native as native
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.get_lib()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    return lib
+
+
+def _numpy_only(fn, *args, **kwargs):
+    saved = native._lib, native._tried
+    native._lib, native._tried = None, True
+    try:
+        return fn(*args, **kwargs)
+    finally:
+        native._lib, native._tried = saved
+
+
+@pytest.mark.parametrize("pad_left", [True, False])
+def test_pad_collate_i32_matches_numpy(lib, pad_left):
+    rng = np.random.default_rng(0)
+    rows = [rng.integers(1, 100, size=rng.integers(0, 12)).astype(np.int32) for _ in range(9)]
+    out_c, mask_c = native.pad_collate_i32(rows, 10, pad_value=0, pad_left=pad_left)
+    out_np, mask_np = _numpy_only(native.pad_collate_i32, rows, 10, pad_value=0, pad_left=pad_left)
+    np.testing.assert_array_equal(out_c, out_np)
+    np.testing.assert_array_equal(mask_c, mask_np)
+
+
+def test_pad_collate_f32_matches_numpy(lib):
+    rng = np.random.default_rng(1)
+    rows = [rng.normal(size=rng.integers(0, 7)).astype(np.float32) for _ in range(5)]
+    out_c = native.pad_collate_f32(rows, 6)
+    out_np = _numpy_only(native.pad_collate_f32, rows, 6)
+    np.testing.assert_array_equal(out_c, out_np)
+
+
+def test_find_stop_positions_matches_numpy(lib):
+    rng = np.random.default_rng(2)
+    seqs = rng.integers(0, 5, size=(16, 20)).astype(np.int32)
+    stops = [[1, 2], [3, 3, 3], [4]]
+    got_c = native.find_stop_positions(seqs, stops)
+    got_np = _numpy_only(native.find_stop_positions, seqs, stops)
+    np.testing.assert_array_equal(got_c, got_np)
+    # sanity: a row with a known stop
+    seqs2 = np.array([[9, 9, 1, 2, 9, 9]], np.int32)
+    assert native.find_stop_positions(seqs2, [[1, 2]])[0] == 2
+    assert native.find_stop_positions(seqs2, [[7]])[0] == 6

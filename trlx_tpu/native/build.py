@@ -1,0 +1,12 @@
+"""CLI: build the native host data plane (`python -m trlx_tpu.native.build`)."""
+
+import sys
+
+from trlx_tpu.native import build
+
+if __name__ == "__main__":
+    path = build(verbose=True)
+    if path is None:
+        print("native build FAILED (numpy fallbacks will be used)", file=sys.stderr)
+        sys.exit(1)
+    print(f"built {path}")

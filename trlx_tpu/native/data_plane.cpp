@@ -1,0 +1,89 @@
+// Host data plane: C++ implementations of the per-rollout host-side hot loops.
+//
+// The reference delegates all native-performance work to external libraries
+// (SURVEY.md §2.4); its host-side Python loops (per-sample pad/collate in
+// `ppo_collate_fn`, stop-sequence scanning in `decode`) run every rollout batch.
+// This module provides those as a small C++ library driven via ctypes
+// (pybind11 is not available in this image), with identical semantics to the
+// numpy fallbacks in trlx_tpu.native.__init__.
+//
+// Build: `python -m trlx_tpu.native.build` (invokes g++ -O3 -shared -fPIC).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Left- or right-pad a ragged batch of int32 rows into out[B, target_len]
+// (pre-filled by caller is NOT required) and write the 0/1 mask.
+// rows: concatenated row data; lengths[B]: row lengths; offsets[B]: row starts.
+// pad_left != 0 -> left padding. Rows longer than target_len are truncated,
+// keeping the tail when left-padding and the head when right-padding (matching
+// ops/generation.left_pad_batch and pipeline/ppo_pipeline.ppo_collate_fn).
+void pad_collate_i32(const int32_t* rows, const int64_t* offsets,
+                     const int64_t* lengths, int64_t batch, int64_t target_len,
+                     int32_t pad_value, int pad_left, int32_t* out,
+                     int32_t* mask) {
+  for (int64_t i = 0; i < batch; ++i) {
+    int32_t* out_row = out + i * target_len;
+    int32_t* mask_row = mask + i * target_len;
+    for (int64_t j = 0; j < target_len; ++j) {
+      out_row[j] = pad_value;
+      mask_row[j] = 0;
+    }
+    int64_t len = lengths[i];
+    const int32_t* src = rows + offsets[i];
+    if (len > target_len) {
+      if (pad_left) src += (len - target_len);  // keep tail
+      len = target_len;
+    }
+    int64_t start = pad_left ? (target_len - len) : 0;
+    std::memcpy(out_row + start, src, len * sizeof(int32_t));
+    for (int64_t j = 0; j < len; ++j) mask_row[start + j] = 1;
+  }
+}
+
+// Same for float32 payloads (logprobs/values/rewards right-padded with zeros).
+void pad_collate_f32(const float* rows, const int64_t* offsets,
+                     const int64_t* lengths, int64_t batch, int64_t target_len,
+                     float pad_value, int pad_left, float* out) {
+  for (int64_t i = 0; i < batch; ++i) {
+    float* out_row = out + i * target_len;
+    for (int64_t j = 0; j < target_len; ++j) out_row[j] = pad_value;
+    int64_t len = lengths[i];
+    const float* src = rows + offsets[i];
+    if (len > target_len) {
+      if (pad_left) src += (len - target_len);
+      len = target_len;
+    }
+    int64_t start = pad_left ? (target_len - len) : 0;
+    std::memcpy(out_row + start, src, len * sizeof(float));
+  }
+}
+
+// For each row of seqs[B, T], find the first occurrence (start index) of any of
+// the given stop token-sequences; writes T (no match) or the match start into
+// out[B]. Stop sequences are concatenated in `stops` with lengths `stop_lens`.
+void find_stop_positions(const int32_t* seqs, int64_t batch, int64_t seq_len,
+                         const int32_t* stops, const int64_t* stop_offsets,
+                         const int64_t* stop_lens, int64_t n_stops,
+                         int64_t* out) {
+  for (int64_t i = 0; i < batch; ++i) {
+    const int32_t* row = seqs + i * seq_len;
+    int64_t best = seq_len;
+    for (int64_t s = 0; s < n_stops; ++s) {
+      const int32_t* pat = stops + stop_offsets[s];
+      int64_t m = stop_lens[s];
+      if (m == 0 || m > seq_len) continue;
+      for (int64_t j = 0; j + m <= seq_len && j < best; ++j) {
+        if (std::memcmp(row + j, pat, m * sizeof(int32_t)) == 0) {
+          if (j < best) best = j;
+          break;
+        }
+      }
+    }
+    out[i] = best;
+  }
+}
+
+}  // extern "C"

@@ -40,15 +40,11 @@ def pad_to_bucket(length: int, buckets: Sequence[int]) -> int:
 def left_pad_batch(
     ids_list: List[np.ndarray], pad_token_id: int, target_len: int
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Host-side: left-pad a ragged list of prompt id arrays to [B, target_len]."""
-    B = len(ids_list)
-    out = np.full((B, target_len), pad_token_id, dtype=np.int32)
-    mask = np.zeros((B, target_len), dtype=np.int32)
-    for i, ids in enumerate(ids_list):
-        ids = np.asarray(ids, dtype=np.int32)[-target_len:]
-        out[i, target_len - len(ids):] = ids
-        mask[i, target_len - len(ids):] = 1
-    return out, mask
+    """Host-side: left-pad a ragged list of prompt id arrays to [B, target_len]
+    (C++ data plane when available, numpy otherwise)."""
+    from trlx_tpu.native import pad_collate_i32
+
+    return pad_collate_i32(ids_list, target_len, pad_token_id, pad_left=True)
 
 
 def generate(

@@ -14,28 +14,22 @@ from trlx_tpu.pipeline import BaseRolloutStore, NumpyLoader
 
 
 def ppo_collate_fn(pad_token_id: int, elems: List[PPORLElement]) -> PPORLBatch:
+    """Left-pad queries / right-pad responses+payloads (parity: ppo_pipeline.py:23-35);
+    the padding loops run in the C++ data plane when available."""
+    from trlx_tpu.native import pad_collate_f32, pad_collate_i32
+
     P = max(len(e.query_tensor) for e in elems)
     R = max(len(e.response_tensor) for e in elems)
-    B = len(elems)
 
-    queries = np.full((B, P), pad_token_id, np.int32)
-    q_mask = np.zeros((B, P), np.int32)
-    responses = np.full((B, R), pad_token_id, np.int32)
-    r_mask = np.zeros((B, R), np.int32)
-    logprobs = np.zeros((B, R), np.float32)
-    values = np.zeros((B, R), np.float32)
-    rewards = np.zeros((B, R), np.float32)
-
-    for i, e in enumerate(elems):
-        q = np.asarray(e.query_tensor, np.int32)
-        r = np.asarray(e.response_tensor, np.int32)
-        queries[i, P - len(q):] = q  # left-pad queries (parity: ppo_pipeline.py:23-35)
-        q_mask[i, P - len(q):] = 1
-        responses[i, : len(r)] = r
-        r_mask[i, : len(r)] = 1
-        logprobs[i, : len(r)] = np.asarray(e.logprobs, np.float32)[: len(r)]
-        values[i, : len(r)] = np.asarray(e.values, np.float32)[: len(r)]
-        rewards[i, : len(r)] = np.asarray(e.rewards, np.float32)[: len(r)]
+    queries, q_mask = pad_collate_i32(
+        [e.query_tensor for e in elems], P, pad_token_id, pad_left=True
+    )
+    responses, r_mask = pad_collate_i32(
+        [e.response_tensor for e in elems], R, pad_token_id, pad_left=False
+    )
+    logprobs = pad_collate_f32([e.logprobs for e in elems], R)
+    values = pad_collate_f32([e.values for e in elems], R)
+    rewards = pad_collate_f32([e.rewards for e in elems], R)
 
     return PPORLBatch(queries, responses, logprobs, values, rewards, q_mask, r_mask)
 
