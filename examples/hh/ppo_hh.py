@@ -1,0 +1,84 @@
+"""PPO on helpful/harmless dialogues (parity: `/root/reference/examples/hh/ppo_hh.py`:
+GPT-J/Llama PPO on Anthropic HH with a served reward model and delta-reward vs the
+dataset's chosen response).
+
+Offline degradation: without the HH dataset/reward checkpoints this runs the same
+wiring on a synthetic dialogue task — a lexicon "helpfulness" reward standing in for
+the served reward model, and the delta-vs-chosen normalization preserved. A remote
+reward model can be wired by replacing ``reward_fn`` with an RPC client (the
+reference uses a Triton client; reward functions are arbitrary user code here too).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+from typing import List
+
+import numpy as np
+
+import trlx_tpu
+from examples.sentiment_task import TINY_MODEL_OVERRIDES, lexicon_sentiment
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_ppo_config
+
+PROMPTS = [
+    "Human: How do I bake bread? Assistant:",
+    "Human: What is a good way to learn piano? Assistant:",
+    "Human: My laptop is slow, what can I do? Assistant:",
+    "Human: How can I sleep better? Assistant:",
+]
+CHOSEN = [
+    " Start with good flour and give the dough time to rise.",
+    " Practice daily with a good teacher and simple pieces.",
+    " Close unused programs and consider more memory.",
+    " Keep a regular schedule and avoid screens late.",
+]
+
+
+def build_config() -> TRLConfig:
+    config = default_ppo_config()
+    config = config.evolve(
+        train={
+            "seq_length": 96, "batch_size": 16, "total_steps": 1500,
+            "eval_interval": 100, "checkpoint_interval": 100000,
+            "checkpoint_dir": "ckpts/ppo_hh", "tracker": "jsonl",
+        },
+        method={"chunk_size": 16, "num_rollouts": 32, "init_kl_coef": 0.05, "target": 6.0,
+                "gen_kwargs": {"max_new_tokens": 32, "top_k": 0, "top_p": 1.0, "do_sample": True}},
+    )
+    model_path = os.environ.get("HH_MODEL", "gpt2")
+    config.model.model_path = model_path
+    if not os.path.isdir(model_path):
+        config.model.model_overrides = dict(TINY_MODEL_OVERRIDES)
+        config.tokenizer.tokenizer_path = "bytes"
+    else:
+        config.tokenizer.tokenizer_path = model_path
+    config.model.num_layers_unfrozen = 2
+    return config
+
+
+def main(hparams={}):
+    config = TRLConfig.update(build_config().to_dict(), hparams)
+    chosen_by_prompt = dict(zip(PROMPTS, CHOSEN))
+
+    def reward_fn(samples: List[str], prompts: List[str], outputs: List[str], **kw):
+        # reward model stand-in; delta vs the dataset's chosen response
+        scores = lexicon_sentiment(outputs)
+        chosen_scores = lexicon_sentiment([chosen_by_prompt.get(p, "") for p in prompts])
+        return [s - c for s, c in zip(scores, chosen_scores)]
+
+    trlx_tpu.train(
+        reward_fn=reward_fn,
+        prompts=PROMPTS * 8,
+        eval_prompts=PROMPTS,
+        config=config,
+        stop_sequences=["Human:", "human:"],
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})

@@ -34,9 +34,20 @@ def default_config(alphabet: str) -> TRLConfig:
 
 
 def main(hparams={}):
-    metric_fn, eval_prompts, walks, _, alphabet = generate_random_walks(seed=1002)
+    import numpy as np
+
+    metric_fn, eval_prompts, walks, adjacency, alphabet = generate_random_walks(seed=1002)
     config = TRLConfig.update(default_config(alphabet).to_dict(), hparams)
     rewards = metric_fn(walks)["optimality"]
+
+    # vocab-sized next-token transition mask (char ids are offset by 3 specials);
+    # specials may follow anything (eos terminates paths)
+    V = len(alphabet) + 3
+    logit_mask = np.zeros((V, V), bool)
+    logit_mask[:, :3] = True
+    logit_mask[3:, 3:] = np.asarray(adjacency, bool)
+    logit_mask[:3, 3:] = True  # first step after bos: any start node
+    config.train.trainer_kwargs["logit_mask"] = logit_mask.tolist()
 
     trlx_tpu.train(
         samples=walks,

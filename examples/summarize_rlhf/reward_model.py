@@ -1,0 +1,109 @@
+"""Pairwise reward model in JAX (parity: the reference's summarize_rlhf reward-model
+stage, `/root/reference/examples/summarize_rlhf/reward_model/`): a causal trunk with a
+scalar head trained on (chosen, rejected) pairs with -log sigmoid(r_c - r_r) loss.
+Offline-capable: tiny random-init trunk + byte tokenizer when no checkpoints exist."""
+
+import sys
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+sys.path.insert(0, ".")
+
+from trlx_tpu.models.heads import MLPHead
+from trlx_tpu.models.presets import PRESETS
+from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
+from trlx_tpu.ops.generation import left_pad_batch
+from trlx_tpu.parallel.mesh import make_mesh, put_batch
+from trlx_tpu.parallel.sharding import make_param_shardings
+
+
+class RewardModel(nn.Module):
+    """Trunk + scalar head; reward = head output at the last real token."""
+
+    config: TransformerConfig
+
+    def setup(self):
+        self.transformer = TransformerLM(self.config)
+        self.reward_head = MLPHead(self.config, out_dim=1)
+
+    def __call__(self, input_ids, attention_mask):
+        _, hidden, _, _ = self.transformer(input_ids, attention_mask)
+        rewards = self.reward_head(hidden)[..., 0]  # [B, T]
+        # reward at the last attended position (inputs are left-padded)
+        return rewards[:, -1]
+
+
+def pairwise_loss(r_chosen: jnp.ndarray, r_rejected: jnp.ndarray) -> jnp.ndarray:
+    return -jnp.mean(jax.nn.log_sigmoid(r_chosen - r_rejected))
+
+
+def train_reward_model(
+    pairs: List[Tuple[str, str]],
+    tokenizer,
+    config: TransformerConfig,
+    steps: int = 200,
+    batch_size: int = 16,
+    seq_len: int = 64,
+    lr: float = 1e-4,
+    seed: int = 0,
+) -> Tuple[RewardModel, dict, Callable[[List[str]], np.ndarray]]:
+    """Train on (chosen, rejected) text pairs; returns (model, params, score_fn)."""
+    mesh = make_mesh()
+    model = RewardModel(config)
+    rng = jax.random.PRNGKey(seed)
+    params = model.init(rng, jnp.ones((1, 4), jnp.int32), jnp.ones((1, 4), jnp.int32))["params"]
+    params = jax.tree.map(jax.device_put, params, make_param_shardings(params, mesh))
+    tx = optax.adamw(lr)
+    with mesh:
+        opt_state = jax.jit(tx.init)(params)
+
+    @jax.jit
+    def step_fn(params, opt_state, c_ids, c_mask, r_ids, r_mask):
+        def loss_fn(p):
+            rc = model.apply({"params": p}, c_ids, c_mask)
+            rr = model.apply({"params": p}, r_ids, r_mask)
+            loss = pairwise_loss(rc, rr)
+            acc = jnp.mean((rc > rr).astype(jnp.float32))
+            return loss, acc
+
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss, acc
+
+    np_rng = np.random.default_rng(seed)
+    for it in range(steps):
+        batch = [pairs[i] for i in np_rng.integers(len(pairs), size=batch_size)]
+        c_ids, c_mask = left_pad_batch(
+            [np.asarray(tokenizer(c).input_ids[:seq_len]) for c, _ in batch],
+            tokenizer.pad_token_id, seq_len,
+        )
+        r_ids, r_mask = left_pad_batch(
+            [np.asarray(tokenizer(r).input_ids[:seq_len]) for _, r in batch],
+            tokenizer.pad_token_id, seq_len,
+        )
+        db = put_batch(mesh, {"ci": c_ids, "cm": c_mask, "ri": r_ids, "rm": r_mask})
+        with mesh:
+            params, opt_state, loss, acc = step_fn(
+                params, opt_state, db["ci"], db["cm"], db["ri"], db["rm"]
+            )
+        if it % 50 == 0:
+            print(f"[rm] step {it} loss {float(loss):.4f} acc {float(acc):.3f}")
+
+    def score_fn(texts: List[str]) -> np.ndarray:
+        ids, mask = left_pad_batch(
+            [np.asarray(tokenizer(t).input_ids[:seq_len]) for t in texts],
+            tokenizer.pad_token_id, seq_len,
+        )
+        db = put_batch(mesh, {"i": ids, "m": mask})
+        with mesh:
+            r = model.apply({"params": params}, db["i"], db["m"])
+        return np.asarray(jax.device_get(r))
+
+    return model, params, score_fn

@@ -1,0 +1,110 @@
+"""3-stage RLHF summarization recipe (parity:
+`/root/reference/examples/summarize_rlhf/` — SFT → reward model → PPO on TL;DR).
+
+With local checkpoints/datasets this runs the real recipe (gpt-j + TL;DR); in the
+zero-egress sandbox it runs the same three stages end-to-end on a synthetic
+summarization task (documents = keyword-stuffed sentences; good summaries repeat the
+keywords) with a tiny model — exercising every stage boundary: SFT export → reward
+model training → PPO against the learned reward with the delta-vs-SFT normalization.
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+from typing import List
+
+import numpy as np
+
+import trlx_tpu
+from examples.summarize_rlhf.reward_model import train_reward_model
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_ppo_config, default_sft_config
+from trlx_tpu.methods.sft import SFTConfig
+from trlx_tpu.models.presets import PRESETS
+from trlx_tpu.pipeline.tokenization import load_tokenizer
+
+TINY = dict(
+    vocab_size=259, hidden_size=128, num_layers=4, num_heads=4,
+    intermediate_size=512, max_position_embeddings=256,
+)
+KEYWORDS = ["storm", "market", "goal", "election", "rocket", "forest", "virus", "bridge"]
+
+
+def make_dataset(n=400, seed=0):
+    """(document, good_summary, bad_summary) triples."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        kws = list(rng.choice(KEYWORDS, size=2, replace=False))
+        doc = f"report about {kws[0]} and {kws[1]} today. TL;DR:"
+        good = f" {kws[0]} {kws[1]}"
+        bad = f" {rng.choice([k for k in KEYWORDS if k not in kws])}"
+        rows.append((doc, good, bad))
+    return rows
+
+
+def main(hparams={}):
+    rows = make_dataset()
+
+    # ---- stage 1: SFT on (doc, good summary)
+    sft_config = default_sft_config()
+    d = sft_config.to_dict()
+    d["method"] = SFTConfig(gen_kwargs=dict(max_new_tokens=8, top_k=1)).to_dict()
+    d["train"].update(
+        seq_length=64, batch_size=32, total_steps=150, eval_interval=150,
+        checkpoint_interval=1000, checkpoint_dir="ckpts/summarize/sft", tracker="jsonl",
+    )
+    d["model"].update(model_path="gpt2", model_overrides=dict(TINY))
+    d["tokenizer"]["tokenizer_path"] = "bytes"
+    d["optimizer"]["kwargs"]["lr"] = 1e-3
+    sft_config = TRLConfig.from_dict(d)
+    sft_trainer = trlx_tpu.train(
+        samples=[[doc, good] for doc, good, _ in rows[:300]],
+        eval_prompts=[rows[0][0]],
+        config=sft_config,
+    )
+    sft_dir = "ckpts/summarize/sft_model"
+    sft_trainer.save_pretrained(sft_dir)
+
+    # ---- stage 2: pairwise reward model on (chosen, rejected)
+    tokenizer = load_tokenizer(sft_config.tokenizer)
+    rm_config = PRESETS["gpt2"].replace(**TINY, compute_dtype=np.float32)
+    pairs = [(doc + good, doc + bad) for doc, good, bad in rows]
+    _, _, score_fn = train_reward_model(pairs, tokenizer, rm_config, steps=150)
+
+    # delta-vs-SFT normalization (parity: reference normalizes PPO rewards by the
+    # reward of the dataset's reference summaries)
+    ref_scores = {doc: float(score_fn([doc + good])[0]) for doc, good, _ in rows[:50]}
+
+    def reward_fn(samples: List[str], prompts: List[str], outputs: List[str], **kw):
+        scores = score_fn(samples)
+        deltas = [s - ref_scores.get(p, 0.0) for s, p in zip(scores, prompts)]
+        return [float(x) for x in deltas]
+
+    # ---- stage 3: PPO from the SFT checkpoint against the learned reward
+    ppo_config = default_ppo_config()
+    ppo_config = ppo_config.evolve(
+        train={
+            "seq_length": 64, "batch_size": 32, "total_steps": 300,
+            "eval_interval": 50, "checkpoint_interval": 10000,
+            "checkpoint_dir": "ckpts/summarize/ppo", "tracker": "jsonl",
+        },
+        method={"chunk_size": 32, "num_rollouts": 64, "init_kl_coef": 0.05,
+                "gen_kwargs": {"max_new_tokens": 8, "top_k": 0, "top_p": 1.0, "do_sample": True}},
+    )
+    ppo_config.model.model_path = sft_dir
+    ppo_config.model.model_overrides = None
+    ppo_config.tokenizer.tokenizer_path = "bytes"
+    ppo_config = TRLConfig.update(ppo_config.to_dict(), hparams)
+
+    prompts = sorted({doc for doc, _, _ in rows[300:]})
+    trlx_tpu.train(
+        reward_fn=reward_fn, prompts=prompts, eval_prompts=prompts[:16], config=ppo_config
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})

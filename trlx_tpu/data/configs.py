@@ -216,6 +216,13 @@ class TrainConfig:
     reward_only_on_last: bool = False
     rollout_logging_dir: Optional[str] = None
 
+    # jax.profiler trace window (TPU equivalent of the reference's NeMo nsys knobs,
+    # configs/nemo_configs/megatron_20b.yaml:128-133): traces steps
+    # [profile_start_step, profile_end_step) into profile_dir.
+    profile_dir: Optional[str] = None
+    profile_start_step: int = 10
+    profile_end_step: int = 12
+
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):
         return cls(**config)

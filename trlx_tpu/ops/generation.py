@@ -9,7 +9,7 @@ static: prompts are left-padded to a bucketed length, the cache is preallocated 
 ``prompt_len + max_new_tokens``, and the sequence buffer is donated across steps.
 
 ILQL's advantage-shaped decoding (reference ``modeling_ilql.py:325-412``) plugs in as
-a ``logits_processor(params, hidden, logits) -> logits`` hook evaluated on the decode
+a ``logits_processor(params, hidden, logits, prev_token) -> logits`` hook evaluated on the decode
 hidden state each step.
 """
 
@@ -83,7 +83,7 @@ def generate(
     logits, hidden, cache = step_fn(params, input_ids, full_mask, positions, cache)
     last_logits = logits[:, -1, :]
     if logits_processor is not None:
-        last_logits = logits_processor(params, hidden[:, -1, :], last_logits)
+        last_logits = logits_processor(params, hidden[:, -1, :], last_logits, input_ids[:, -1])
 
     seqs = jnp.concatenate([input_ids, jnp.full((B, N), pad_token_id, jnp.int32)], axis=1)
 
@@ -125,7 +125,7 @@ def generate(
         logits, hidden, cache = step_fn(params, tok[:, None], full_mask, pos, cache)
         step_logits = logits[:, -1, :]
         if logits_processor is not None:
-            step_logits = logits_processor(params, hidden[:, -1, :], step_logits)
+            step_logits = logits_processor(params, hidden[:, -1, :], step_logits, tok)
         rng, new_tok = sample_step(rng, step, step_logits, finished)
         new_finished = finished
         if eos_token_id is not None:
@@ -215,7 +215,7 @@ def generate_seq2seq(
         )
         step_logits = logits[:, -1, :]
         if logits_processor is not None:
-            step_logits = logits_processor(params, hidden[:, -1, :], step_logits)
+            step_logits = logits_processor(params, hidden[:, -1, :], step_logits, tok)
         rng, new_tok = sample_step(rng, step, step_logits, finished)
         new_finished = finished
         if eos_token_id is not None:

@@ -74,13 +74,16 @@ def make_experience(samples, rewards, tokenizer=None, max_length: int = 2048, ve
 
 @register_trainer
 class ILQLTrainer(MeshRLTrainer):
-    def __init__(self, config: TRLConfig, **kwargs):
+    def __init__(self, config: TRLConfig, logit_mask=None, **kwargs):
         super().__init__(config, **kwargs)
         if not isinstance(config.method, ILQLConfig):
             raise ValueError("ILQLTrainer requires method=ILQLConfig")
         self.method: ILQLConfig = config.method
         # `beta` shapes decode logits; it is not a generation-engine kwarg
         self.ilql_beta = float(self.generate_kwargs.pop("beta", 1.0))
+        # optional [V, V] next-token transition mask (parity: reference trainers'
+        # logit_mask kwarg used by randomwalks; masks invalid successor tokens)
+        self.logit_mask = None if logit_mask is None else np.asarray(logit_mask, bool)
         self._train_steps = {}
         self._sync_fn = None
 
@@ -136,8 +139,9 @@ class ILQLTrainer(MeshRLTrainer):
         (parity: modeling_ilql.py:325-412)."""
         module = self.module
         beta = self.ilql_beta
+        logit_mask = None if self.logit_mask is None else jnp.asarray(self.logit_mask)
 
-        def processor(params, hidden, logits):
+        def processor(params, hidden, logits, prev_tok):
             qs, target_qs, vs = module.apply(
                 {"params": {"ilql_heads": params["ilql_heads"]}},
                 hidden[:, None, :],
@@ -147,7 +151,13 @@ class ILQLTrainer(MeshRLTrainer):
             for tq in target_qs[1:]:
                 q = jnp.minimum(q, tq)
             adv = q[:, 0, :] - vs[:, 0, :]
-            return logits + beta * adv
+            shaped = logits + beta * adv
+            if logit_mask is not None:
+                # parity: reference masks logits by the previous token's allowed
+                # successors (modeling_ilql.py generate: logits[~mask[last]] = -inf)
+                allowed = logit_mask[prev_tok]  # [B, V] bool
+                shaped = jnp.where(allowed, shaped, -1e10)
+            return shaped
 
         return processor
 

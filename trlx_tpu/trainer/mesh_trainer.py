@@ -369,8 +369,16 @@ class MeshRLTrainer(BaseRLTrainer):
         results = self.evaluate() if getattr(self, "eval_pipeline", None) else {}
         self.tracker.log(results, self.iter_count)
 
+        profiling = False
         for epoch in range(train_config.epochs):
             for batch in self.create_train_dataloader():
+                if train_config.profile_dir:
+                    if self.iter_count == train_config.profile_start_step and not profiling:
+                        jax.profiler.start_trace(train_config.profile_dir)
+                        profiling = True
+                    elif self.iter_count >= train_config.profile_end_step and profiling:
+                        jax.profiler.stop_trace()
+                        profiling = False
                 forward_time = self.clock.tick()
                 stats = self.train_step(batch)
                 stats["time/forward_backward"] = self.clock.tick()
