@@ -153,3 +153,30 @@ def test_ppo_seq2seq_end_to_end(tmp_path):
         config=config,
     )
     assert trainer.iter_count >= 3
+
+
+@pytest.mark.slow
+def test_ilql_seq2seq_end_to_end(tmp_path):
+    """T5 ILQL path (parity: reference seq2seq ILQL, ilql_sentiments_t5)."""
+    kwargs = base_kwargs(tmp_path, "ILQLTrainer")
+    kwargs["model"] = ModelConfig(
+        model_path="t5", model_arch_type="seq2seq", num_layers_unfrozen=-1,
+        model_overrides=dict(
+            vocab_size=len(ALPHABET) + 3, d_model=32, d_kv=8, d_ff=64,
+            num_layers=2, num_decoder_layers=2, num_heads=4,
+            relative_attention_num_buckets=8, decoder_start_token_id=1,
+        ),
+    )
+    config = TRLConfig(
+        method=ILQLConfig(
+            steps_for_target_q_sync=2, two_qs=True,
+            gen_kwargs=dict(max_new_tokens=4, top_k=4, beta=1.0, temperature=1.0),
+        ),
+        **kwargs,
+    )
+    samples = [["ab", "cd"], ["ef", "gh"], ["a", "bc"], ["de", "fg"]] * 2
+    rewards = [1.0, 0.5, -0.5, 0.25] * 2
+    trainer = trlx_tpu.train(
+        samples=samples, rewards=rewards, eval_prompts=["ab", "ef"], config=config
+    )
+    assert trainer.iter_count >= 3

@@ -67,9 +67,14 @@ class ILQLConfig(MethodConfig):
         terminal_mask = batch.dones[:, :-1].astype(vs.dtype)
         n_nonterminal = jnp.maximum(terminal_mask.sum(), 1.0)
 
-        # token ids actually taken at each action position: input_ids shifted left,
-        # gathered at action indices
-        actions = jnp.take_along_axis(batch.input_ids[:, 1:], batch.actions_ixs, axis=1)
+        # token ids actually taken at each action position (parity with the
+        # reference's ILQLBatch-vs-seq2seq dispatch, modeling_ilql.py:99-103):
+        # causal — input_ids shifted left, gathered at action indices;
+        # seq2seq — decoder tokens after decoder_start
+        if hasattr(batch, "decoder_input_ids"):
+            actions = batch.decoder_input_ids[:, 1:]
+        else:
+            actions = jnp.take_along_axis(batch.input_ids[:, 1:], batch.actions_ixs, axis=1)
         bsize, nactions = actions.shape
         dsize = logits.shape[-1]
 

@@ -191,3 +191,53 @@ def _t5_head_cfg(t5_config):
         vocab_size=t5_config.vocab_size, hidden_size=t5_config.d_model,
         param_dtype=t5_config.param_dtype, compute_dtype=t5_config.compute_dtype,
     )
+
+
+class Seq2SeqLMWithILQLHeads(nn.Module):
+    """T5 + ILQL {V, Q, target-Q} heads over decoder hidden states
+    (parity: ``AutoModelForSeq2SeqLMWithILQLHeads``, modeling_ilql.py:481-666)."""
+
+    config: "object"  # trlx_tpu.models.t5.T5Config
+    two_qs: bool = True
+
+    def setup(self):
+        from trlx_tpu.models.t5 import T5LM
+
+        self.t5 = T5LM(self.config)
+        self.ilql_heads = ILQLHeads(_t5_head_cfg(self.config), two_qs=self.two_qs)
+
+    def __call__(
+        self,
+        input_ids,
+        attention_mask,
+        decoder_input_ids,
+        decoder_attention_mask=None,
+        actions_ixs=None,
+        states_ixs=None,
+    ):
+        logits, hidden, _ = self.t5(
+            input_ids, attention_mask, decoder_input_ids, decoder_attention_mask
+        )
+        if states_ixs is not None:
+            states_hs = batched_index_select(hidden, states_ixs)
+            actions_hs = batched_index_select(hidden, actions_ixs)
+        else:
+            states_hs = actions_hs = hidden
+        qs, target_qs, vs = self.ilql_heads(states_hs, actions_hs)
+        return logits, qs, target_qs, vs
+
+    def heads_only(self, hidden):
+        return self.ilql_heads(hidden, hidden)
+
+    def encode(self, input_ids, attention_mask):
+        return self.t5.encode(input_ids, attention_mask)
+
+    def precompute_cross_kv(self, enc_states):
+        return self.t5.precompute_cross_kv(enc_states)
+
+    def decode_step(self, decoder_input_ids, enc_states, encoder_attention_mask,
+                    decoder_attention_mask, positions, cache, cross_kvs):
+        return self.t5.decode(
+            decoder_input_ids, enc_states, encoder_attention_mask,
+            decoder_attention_mask, positions, cache, cross_kvs,
+        )

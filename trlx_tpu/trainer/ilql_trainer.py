@@ -88,9 +88,13 @@ class ILQLTrainer(MeshRLTrainer):
         self._sync_fn = None
 
     def setup_model(self):
+        self.is_seq2seq = self.config.model.model_arch_type == "seq2seq"
         overrides = dict(self.config.model.model_overrides or {})
         overrides.setdefault("param_dtype", self.param_dtype)
         overrides.setdefault("compute_dtype", self.compute_dtype)
+        if self.is_seq2seq:
+            self._setup_seq2seq_model(overrides)
+            return
         overrides.setdefault("remat", self.config.mesh.remat)
         from trlx_tpu.models.hf_loading import merge_loaded_params, peft_overrides
 
@@ -115,6 +119,52 @@ class ILQLTrainer(MeshRLTrainer):
         self.params = jax.tree.map(
             lambda x, s: jax.device_put(jnp.asarray(x, self.param_dtype), s), params, shardings
         )
+
+    def _setup_seq2seq_model(self, overrides):
+        from trlx_tpu.models.hf_loading import load_pretrained_seq2seq, merge_loaded_params
+        from trlx_tpu.models.policy import Seq2SeqLMWithILQLHeads
+
+        self.model_config, t5_params = load_pretrained_seq2seq(
+            self.config.model.model_path, overrides
+        )
+        self.model_type = "t5"
+        self.decoder_start_token_id = self.model_config.decoder_start_token_id
+        self.module = Seq2SeqLMWithILQLHeads(self.model_config, two_qs=self.config.method.two_qs)
+        params = self.module.init(
+            jax.random.PRNGKey(self.config.train.seed),
+            jnp.ones((1, 4), jnp.int32), jnp.ones((1, 4), jnp.int32),
+            jnp.zeros((1, 3), jnp.int32),
+        )["params"]
+        if t5_params is not None:
+            params = dict(params)
+            params["t5"] = merge_loaded_params(params["t5"], t5_params)
+        params["ilql_heads"] = _sync_heads(dict(params["ilql_heads"]), alpha=1.0)
+        shardings = make_param_shardings(params, self.mesh)
+        self.params = jax.tree.map(
+            lambda x, s: jax.device_put(jnp.asarray(x, self.param_dtype), s), params, shardings
+        )
+
+    def seq2seq_gen_fns(self):
+        module = self.module
+
+        return {
+            "encode": lambda params, ids, mask: module.apply(
+                {"params": params}, ids, mask, method=module.encode
+            ),
+            "cross_kv": lambda params, enc: module.apply(
+                {"params": params}, enc, method=module.precompute_cross_kv
+            ),
+            "decode": lambda params, tok, enc, enc_mask, dec_mask, pos, cache, ckv: module.apply(
+                {"params": params}, tok, enc, enc_mask, dec_mask, pos, cache, ckv,
+                method=module.decode_step,
+            ),
+            "init_cache": lambda params, b, n: self._t5().init_cache(b, n),
+        }
+
+    def _t5(self):
+        from trlx_tpu.models.t5 import T5LM
+
+        return T5LM(self.model_config)
 
     def trainable_path_predicate(self, path: str) -> bool:
         if "target_q_heads" in path:
@@ -164,7 +214,10 @@ class ILQLTrainer(MeshRLTrainer):
     # ------------------------------------------------------------- experience
 
     def make_experience(self, samples, rewards, max_length: int = 2048):
-        self.store = make_experience(samples, rewards, self.tokenizer, max_length)
+        if getattr(self, "is_seq2seq", False):
+            self.store = make_experience_seq2seq(samples, rewards, self.tokenizer, max_length)
+        else:
+            self.store = make_experience(samples, rewards, self.tokenizer, max_length)
 
     # ------------------------------------------------------------- train loop
 
@@ -195,7 +248,27 @@ class ILQLTrainer(MeshRLTrainer):
         self._train_steps[key] = self.make_grad_accum_step(loss_fn, self.num_mb)
         return self._train_steps[key]
 
+    def _get_train_step_s2s(self, B: int, T: int, D: int):
+        key = ("s2s", B, T, D)
+        if key in self._train_steps:
+            return self._train_steps[key]
+        module, method = self.module, self.method
+
+        def loss_fn(params, mb):
+            logits, qs, target_qs, vs = module.apply(
+                {"params": params}, mb.input_ids, mb.attention_mask,
+                mb.decoder_input_ids, None, mb.actions_ixs, mb.states_ixs,
+            )
+            action_logits = batched_index_select(logits, mb.actions_ixs)
+            loss, stats = method.loss((action_logits, (qs, target_qs, vs)), mb)
+            return loss, flatten_dict(stats)
+
+        self._train_steps[key] = self.make_grad_accum_step(loss_fn, self.num_mb)
+        return self._train_steps[key]
+
     def train_step(self, batch: ILQLBatch) -> Dict[str, float]:
+        if getattr(self, "is_seq2seq", False):
+            return self._train_step_s2s(batch)
         B, T = batch.input_ids.shape
         A = batch.actions_ixs.shape[1]
         Tb, Ab = pad_to_bucket(T, BUCKETS), pad_to_bucket(A, BUCKETS)
@@ -210,6 +283,32 @@ class ILQLTrainer(MeshRLTrainer):
         )
         dbatch = mesh_lib.put_batch(self.mesh, padded)
         step = self._get_train_step(B, Tb, Ab)
+        with self.mesh:
+            self.params, self.opt_state, stats = step(self.params, self.opt_state, dbatch)
+        return {k: float(v) for k, v in jax.device_get(stats).items()}
+
+    def _train_step_s2s(self, batch) -> Dict[str, float]:
+        from trlx_tpu.data.ilql_types import ILQLSeq2SeqBatch
+
+        B, T = batch.input_ids.shape
+        D = batch.decoder_input_ids.shape[1]
+        A = batch.actions_ixs.shape[1]
+        Tb = pad_to_bucket(T, BUCKETS)
+        # the loss takes actions = decoder_input_ids[:, 1:], so D must equal A+1
+        Ab = pad_to_bucket(max(A, D - 1), BUCKETS)
+        Db = Ab + 1
+        pad2 = lambda x, n, v=0: np.pad(np.asarray(x), ((0, 0), (0, n - x.shape[1])), constant_values=v)
+        padded = ILQLSeq2SeqBatch(
+            input_ids=pad2(batch.input_ids, Tb, self.tokenizer.pad_token_id),
+            attention_mask=pad2(batch.attention_mask, Tb),
+            decoder_input_ids=pad2(batch.decoder_input_ids, Db, self.tokenizer.pad_token_id),
+            rewards=pad2(batch.rewards, Ab, 0.0),
+            states_ixs=pad2(batch.states_ixs, Ab + 1),
+            actions_ixs=pad2(batch.actions_ixs, Ab),
+            dones=pad2(batch.dones, Ab + 1),
+        )
+        dbatch = mesh_lib.put_batch(self.mesh, padded)
+        step = self._get_train_step_s2s(B, Tb, Db)
         with self.mesh:
             self.params, self.opt_state, stats = step(self.params, self.opt_state, dbatch)
         return {k: float(v) for k, v in jax.device_get(stats).items()}
@@ -229,3 +328,46 @@ class ILQLTrainer(MeshRLTrainer):
                 self._sync_fn = jax.jit(sync, donate_argnums=0)
             with self.mesh:
                 self.params = self._sync_fn(self.params)
+
+
+def make_experience_seq2seq(samples, rewards, tokenizer=None, max_length: int = 2048, verbose: bool = True):
+    """Seq2seq ILQL experience (parity: accelerate_ilql_trainer.py:178-243):
+    encoder input = prompt tokens, decoder = output tokens; actions over the decoder
+    sequence; standardized returns on the last action."""
+    from trlx_tpu.pipeline.offline_pipeline import ILQLSeq2SeqRolloutStorage
+
+    if verbose:
+        logger.info("Collecting rollouts (seq2seq)")
+    if tokenizer is not None:
+        samples = [tokenize_dialogue(s, tokenizer, max_length) for s in samples]
+
+    all_input_ids, all_output_ids, all_actions_ixs, all_states_ixs, all_dones = [], [], [], [], []
+    for sample in samples:
+        prompt_msgs = [m for m in sample if not m.is_output]
+        output_msgs = [m for m in sample if m.is_output]
+        all_input_ids.append(
+            np.asarray([t for m in prompt_msgs for t in m.tokens], np.int32)
+        )
+        out = np.asarray([t for m in output_msgs for t in m.tokens], np.int32)
+        all_output_ids.append(out)
+        length = len(out)
+        actions_ixs = np.arange(0, max(length - 1, 1))
+        states_ixs = np.concatenate([actions_ixs, [max(length - 1, 1)]])
+        all_dones.append(np.asarray([1] * (len(states_ixs) - 1) + [0], np.int32))
+        all_actions_ixs.append(actions_ixs.astype(np.int32))
+        all_states_ixs.append(states_ixs.astype(np.int32))
+
+    returns = np.asarray(rewards, np.float64)
+    returns = returns - returns.mean()
+    std = returns.std()
+    if not np.isnan(std) and std > 0:
+        returns = returns / (std + np.finfo(returns.dtype).eps)
+    rewards_per_token = [np.zeros(len(x), np.float32) for x in all_actions_ixs]
+    for rs, ret in zip(rewards_per_token, returns):
+        rs[-1] = ret
+
+    attention_mask = [np.ones(len(x), np.int32) for x in all_input_ids]
+    return ILQLSeq2SeqRolloutStorage(
+        all_input_ids, attention_mask, all_output_ids, rewards_per_token,
+        all_states_ixs, all_actions_ixs, all_dones,
+    )
