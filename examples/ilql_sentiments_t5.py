@@ -1,0 +1,40 @@
+"""ILQL sentiments with a T5 seq2seq model (parity:
+`/root/reference/examples/ilql_sentiments_t5.py`): offline RL on (prompt, completion)
+pairs with sentiment rewards, seq2seq arch."""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import trlx_tpu
+from examples.ppo_sentiments_t5 import T5_TINY
+from examples.sentiment_task import PROMPT_STUBS, build_corpus, lexicon_sentiment
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_ilql_config
+
+
+def main(hparams={}):
+    config = default_ilql_config()
+    config = config.evolve(
+        train={
+            "seq_length": 64, "batch_size": 16, "total_steps": 500,
+            "checkpoint_dir": "ckpts/ilql_sentiments_t5", "tracker": "jsonl",
+        },
+    )
+    config.model.model_arch_type = "seq2seq"
+    config.model.model_path = "t5"
+    config.model.model_overrides = dict(T5_TINY)
+    config.tokenizer.tokenizer_path = "bytes"
+    config = TRLConfig.update(config.to_dict(), hparams)
+
+    corpus = build_corpus(256)
+    # (prompt, completion) dialogue pairs: split each review at its first clause
+    samples = [[s[: len(s) // 2], s[len(s) // 2 :]] for s in corpus]
+    rewards = lexicon_sentiment(corpus)
+    trlx_tpu.train(samples=samples, rewards=rewards, eval_prompts=PROMPT_STUBS, config=config)
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})

@@ -1,0 +1,52 @@
+"""PPO sentiments on the Llama family (parity:
+`/root/reference/examples/ppo_sentiments_llama.py`). With a local Llama checkpoint
+(env LLAMA_MODEL) this fine-tunes it (set mesh fsdp/model for 7B+); offline it runs
+a tiny random-init llama-architecture model (RMSNorm/rotary/SwiGLU/GQA exercised)."""
+
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+import trlx_tpu
+from examples.ppo_sentiments import reward_fn
+from examples.sentiment_task import PROMPT_STUBS, lexicon_sentiment
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_ppo_config
+
+LLAMA_TINY = dict(
+    vocab_size=259, hidden_size=128, num_layers=4, num_heads=4, num_kv_heads=2,
+    intermediate_size=352, max_position_embeddings=256,
+)
+
+
+def main(hparams={}):
+    config = default_ppo_config()
+    config = config.evolve(
+        train={
+            "seq_length": 64, "batch_size": 16, "total_steps": 1000,
+            "checkpoint_dir": "ckpts/ppo_sentiments_llama", "tracker": "jsonl",
+        },
+        method={"chunk_size": 16, "num_rollouts": 32,
+                "gen_kwargs": {"max_new_tokens": 24, "top_k": 0, "top_p": 1.0, "do_sample": True}},
+    )
+    model_path = os.environ.get("LLAMA_MODEL", "meta-llama/Llama-2-7b-hf")
+    if os.path.isdir(model_path):
+        config.model.model_path = model_path
+        config.tokenizer.tokenizer_path = model_path
+        config.model.num_layers_unfrozen = 2
+        config = config.evolve(mesh={"fsdp": 4, "model": 2, "remat": "nothing_saveable"})
+    else:
+        config.model.model_path = "llama"
+        config.model.model_overrides = dict(LLAMA_TINY)
+        config.tokenizer.tokenizer_path = "bytes"
+    config = TRLConfig.update(config.to_dict(), hparams)
+    trlx_tpu.train(
+        reward_fn=reward_fn, prompts=PROMPT_STUBS * 4, eval_prompts=PROMPT_STUBS, config=config
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})

@@ -1,0 +1,29 @@
+"""PPO sentiments with LoRA adapters (parity:
+`/root/reference/examples/ppo_sentiments_peft.py`): only adapters + value head
+train; export folds adapters into the base weights."""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import trlx_tpu
+from examples.ppo_sentiments import build_config, reward_fn
+from examples.sentiment_task import PROMPT_STUBS
+from trlx_tpu.data.configs import TRLConfig
+
+
+def main(hparams={}):
+    config = build_config()
+    config.model.peft_config = {"peft_type": "LORA", "r": 8, "lora_alpha": 16,
+                                "target_modules": ["q_proj", "v_proj"]}
+    config.train.checkpoint_dir = "ckpts/ppo_sentiments_peft"
+    config = TRLConfig.update(config.to_dict(), hparams)
+    trlx_tpu.train(
+        reward_fn=reward_fn, prompts=PROMPT_STUBS * 4, eval_prompts=PROMPT_STUBS, config=config
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})

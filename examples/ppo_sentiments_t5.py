@@ -1,0 +1,58 @@
+"""PPO sentiments with a T5 seq2seq model (parity:
+`/root/reference/examples/ppo_sentiments_t5.py`): the encoder reads the prompt, PPO
+optimizes decoder continuations. Offline: tiny random-init T5 + byte tokenizer;
+with local flan-t5 checkpoints the same script runs the real task."""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import os
+
+import trlx_tpu
+from examples.sentiment_task import PROMPT_STUBS, lexicon_sentiment
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_ppo_config
+
+T5_TINY = dict(
+    vocab_size=259, d_model=64, d_kv=16, d_ff=256, num_layers=2,
+    num_decoder_layers=2, num_heads=4, decoder_start_token_id=1,
+)
+
+
+def build_config() -> TRLConfig:
+    config = default_ppo_config()
+    config = config.evolve(
+        train={
+            "seq_length": 64, "batch_size": 16, "total_steps": 1000,
+            "checkpoint_dir": "ckpts/ppo_sentiments_t5", "tracker": "jsonl",
+        },
+        method={"chunk_size": 16, "num_rollouts": 32,
+                "gen_kwargs": {"max_new_tokens": 16, "top_k": 0, "top_p": 1.0, "do_sample": True}},
+    )
+    config.model.model_arch_type = "seq2seq"
+    model_path = os.environ.get("T5_MODEL", "google/flan-t5-small")
+    if os.path.isdir(model_path):
+        config.model.model_path = model_path
+        config.tokenizer.tokenizer_path = model_path
+    else:
+        config.model.model_path = "t5"
+        config.model.model_overrides = dict(T5_TINY)
+        config.tokenizer.tokenizer_path = "bytes"
+    return config
+
+
+def main(hparams={}):
+    config = TRLConfig.update(build_config().to_dict(), hparams)
+    trlx_tpu.train(
+        reward_fn=lambda samples, outputs=None, **kw: lexicon_sentiment(outputs or samples),
+        prompts=PROMPT_STUBS * 4,
+        eval_prompts=PROMPT_STUBS,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})
