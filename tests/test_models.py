@@ -141,3 +141,25 @@ def test_get_preset_prefix_matching():
     assert get_preset("meta-llama/Llama-2-7b-hf").glu
     with pytest.raises(ValueError):
         get_preset("some-unknown-arch")
+
+
+def test_value_branch():
+    """num_value_layers > 0 gives the value fn its own trainable top-layer branch
+    (parity: make_value_branch, modeling_ppo.py:255-263)."""
+    config = tiny_config("gpt2")
+    model = CausalLMWithValueHead(config, num_value_layers=1)
+    rng = jax.random.PRNGKey(5)
+    ids = jax.random.randint(rng, (2, 6), 1, config.vocab_size)
+    mask = jnp.ones((2, 6), jnp.int32)
+    params = model.init(rng, ids, mask)["params"]
+    assert "value_blocks_0" in params and "value_ln" in params
+    logits, values, branch_hidden, _ = model.apply({"params": params}, ids, mask, branch_layer=1)
+    assert values.shape == (2, 6)
+    assert branch_hidden is not None and branch_hidden.shape == (2, 6, config.hidden_size)
+    # the value branch params receive gradients
+    def loss(p):
+        _, v, _, _ = model.apply({"params": p}, ids, mask)
+        return jnp.sum(v**2)
+    grads = jax.grad(loss)(params)
+    g = np.abs(np.asarray(grads["value_blocks_0"]["attn"]["q_proj"]["kernel"])).sum()
+    assert g > 0

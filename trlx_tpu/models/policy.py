@@ -24,13 +24,33 @@ from trlx_tpu.models.transformer import KVCache, TransformerConfig, TransformerL
 
 class CausalLMWithValueHead(nn.Module):
     """Trunk LM + scalar value head. ``branch_layer`` (when set in a call) returns the
-    activation entering that layer, for the hydra reference branch."""
+    activation entering that layer, for the hydra reference branch.
+
+    ``num_value_layers`` > 0 gives the value function its own trainable *branch* of
+    top layers fed from the trunk activation ``num_value_layers`` from the top
+    (parity: ``make_value_branch``, modeling_ppo.py:255-263)."""
 
     config: TransformerConfig
+    num_value_layers: int = 0
 
     def setup(self):
+        from trlx_tpu.models.transformer import Block, _norm_module
+
         self.transformer = TransformerLM(self.config)
         self.v_head = ValueHead(self.config)
+        if self.num_value_layers > 0:
+            self.value_blocks = [Block(self.config) for _ in range(self.num_value_layers)]
+            self.value_ln = _norm_module(self.config)
+
+    def _value_branch(self, hidden, attention_mask):
+        from trlx_tpu.models.transformer import make_causal_bias
+
+        B, T, _ = hidden.shape
+        positions, mask_bias = make_causal_bias(attention_mask, B, T)
+        x = hidden
+        for blk in self.value_blocks:
+            x, _ = blk(x, mask_bias, positions, None, attention_mask)
+        return self.v_head(self.value_ln(x))
 
     def __call__(
         self,
@@ -40,6 +60,15 @@ class CausalLMWithValueHead(nn.Module):
         cache: Optional[KVCache] = None,
         branch_layer: Optional[int] = None,
     ):
+        if self.num_value_layers > 0 and cache is None:
+            value_start = self.config.num_layers - self.num_value_layers
+            capture = sorted({value_start, *(() if branch_layer is None else (branch_layer,))})
+            logits, hidden, captures, new_cache = self.transformer(
+                input_ids, attention_mask, positions, cache, tuple(capture)
+            )
+            values = self._value_branch(captures[value_start], attention_mask)
+            branch_hidden = None if branch_layer is None else captures[branch_layer]
+            return logits, values, branch_hidden, new_cache
         logits, hidden, branch_hidden, new_cache = self.transformer(
             input_ids, attention_mask, positions, cache, branch_layer
         )

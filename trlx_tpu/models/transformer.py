@@ -107,6 +107,18 @@ def _norm_module(config: TransformerConfig, name: Optional[str] = None):
     return nn.LayerNorm(**kw)
 
 
+def make_causal_bias(attention_mask: Optional[jnp.ndarray], B: int, T: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(positions, additive causal+padding mask bias) for a cache-free forward."""
+    if attention_mask is not None:
+        positions = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0, None).astype(jnp.int32)
+    else:
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+    causal = jnp.tril(jnp.ones((T, T), dtype=bool))[None, None, :, :]
+    if attention_mask is not None:
+        causal = jnp.logical_and(causal, attention_mask[:, None, None, :].astype(bool))
+    return positions, jnp.where(causal, 0.0, -1e9).astype(jnp.float32)
+
+
 def make_rotary(config: TransformerConfig, positions: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """cos/sin tables [B, T, rot_dim/2] for the given positions."""
     rot_dim = int(config.dim_per_head * config.rotary_pct)
@@ -406,11 +418,16 @@ class TransformerLM(nn.Module):
 
         x = self.embed(input_ids, positions)
         kv_valid = attention_mask if cache is None else None
+        # branch_layer: int -> return that single activation; tuple -> dict of them
+        capture_set = ()
+        if branch_layer is not None:
+            capture_set = branch_layer if isinstance(branch_layer, tuple) else (branch_layer,)
+        captures = {}
         branch_hidden = None
         new_layer_caches = []
         for i, layer in enumerate(self.layers):
-            if branch_layer is not None and i == branch_layer:
-                branch_hidden = x
+            if i in capture_set:
+                captures[i] = x
             layer_cache = None
             if cache is not None:
                 layer_cache = {"k": cache["k"][i], "v": cache["v"][i], "index": cache["index"]}
@@ -425,7 +442,11 @@ class TransformerLM(nn.Module):
                 "v": jnp.stack([lc["v"] for lc in new_layer_caches]),
                 "index": cache["index"] + T,
             }
-        return logits, hidden, branch_hidden, new_cache
+        if branch_layer is not None and not isinstance(branch_layer, tuple):
+            branch_out = captures.get(branch_layer)
+        else:
+            branch_out = captures if isinstance(branch_layer, tuple) else None
+        return logits, hidden, branch_out, new_cache
 
     def forward_from(
         self,

@@ -82,7 +82,10 @@ class PPOTrainer(MeshRLTrainer):
         self.model_config, trunk_params, self.model_type = load_pretrained(
             self.config.model.model_path, overrides
         )
-        self.module = CausalLMWithValueHead(self.model_config)
+        self.module = CausalLMWithValueHead(
+            self.model_config,
+            num_value_layers=getattr(self.config.method, "num_value_layers_unfrozen", 0),
+        )
         self.trunk_module = TransformerLM(self.model_config)
 
         params = self.module.init(
