@@ -163,3 +163,33 @@ def test_value_branch():
     grads = jax.grad(loss)(params)
     g = np.abs(np.asarray(grads["value_blocks_0"]["attn"]["q_proj"]["kernel"])).sum()
     assert g > 0
+
+
+def test_value_branch_inits_from_trunk():
+    """Value branch starts from the pretrained top-layer weights (ModelBranch
+    deepcopy parity), not random init."""
+    from trlx_tpu.models.policy import init_value_branch_from_trunk
+
+    config = tiny_config("gpt2")
+    model = CausalLMWithValueHead(config, num_value_layers=1)
+    rng = jax.random.PRNGKey(6)
+    ids = jax.random.randint(rng, (1, 4), 1, config.vocab_size)
+    params = dict(model.init(rng, ids, jnp.ones_like(ids))["params"])
+    params = init_value_branch_from_trunk(params, config, 1)
+    np.testing.assert_array_equal(
+        np.asarray(params["value_blocks_0"]["attn"]["q_proj"]["kernel"]),
+        np.asarray(params["transformer"]["layers_1"]["attn"]["q_proj"]["kernel"]),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(params["value_ln"]["scale"]),
+        np.asarray(params["transformer"]["ln_f"]["scale"]),
+    )
+
+
+def test_value_branch_rejects_cache_and_overdepth():
+    config = tiny_config("gpt2")
+    import pytest as _pytest
+
+    model = CausalLMWithValueHead(config, num_value_layers=5)  # > num_layers=2
+    with _pytest.raises(ValueError):
+        model.init(jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32), jnp.ones((1, 4), jnp.int32))

@@ -36,17 +36,24 @@ class CausalLMWithValueHead(nn.Module):
     def setup(self):
         from trlx_tpu.models.transformer import Block, _norm_module
 
+        if self.num_value_layers > self.config.num_layers:
+            raise ValueError(
+                f"num_value_layers_unfrozen={self.num_value_layers} exceeds "
+                f"num_layers={self.config.num_layers}"
+            )
         self.transformer = TransformerLM(self.config)
         self.v_head = ValueHead(self.config)
         if self.num_value_layers > 0:
             self.value_blocks = [Block(self.config) for _ in range(self.num_value_layers)]
             self.value_ln = _norm_module(self.config)
 
-    def _value_branch(self, hidden, attention_mask):
+    def _value_branch(self, hidden, attention_mask, positions):
         from trlx_tpu.models.transformer import make_causal_bias
 
         B, T, _ = hidden.shape
-        positions, mask_bias = make_causal_bias(attention_mask, B, T)
+        default_positions, mask_bias = make_causal_bias(attention_mask, B, T)
+        if positions is None:
+            positions = default_positions
         x = hidden
         for blk in self.value_blocks:
             x, _ = blk(x, mask_bias, positions, None, attention_mask)
@@ -60,13 +67,20 @@ class CausalLMWithValueHead(nn.Module):
         cache: Optional[KVCache] = None,
         branch_layer: Optional[int] = None,
     ):
-        if self.num_value_layers > 0 and cache is None:
+        if self.num_value_layers > 0:
+            if cache is not None:
+                # the trained value fn is value_ln(value_blocks(...)); v_head on the
+                # trunk hidden would silently return meaningless numbers
+                raise NotImplementedError(
+                    "value-branch models do not support cached decode value reads; "
+                    "use lm_only for generation"
+                )
             value_start = self.config.num_layers - self.num_value_layers
             capture = sorted({value_start, *(() if branch_layer is None else (branch_layer,))})
             logits, hidden, captures, new_cache = self.transformer(
                 input_ids, attention_mask, positions, cache, tuple(capture)
             )
-            values = self._value_branch(captures[value_start], attention_mask)
+            values = self._value_branch(captures[value_start], attention_mask, positions)
             branch_hidden = None if branch_layer is None else captures[branch_layer]
             return logits, values, branch_hidden, new_cache
         logits, hidden, branch_hidden, new_cache = self.transformer(
@@ -139,6 +153,24 @@ class CausalLMWithILQLHeads(nn.Module):
         """Apply the ILQL heads to trunk hidden states [B, T, H] (used by the
         advantage-shaped decode, parity: modeling_ilql.py:325-412)."""
         return self.ilql_heads(hidden, hidden)
+
+
+def init_value_branch_from_trunk(params: Dict[str, Any], config: TransformerConfig, num_value_layers: int) -> Dict[str, Any]:
+    """Copy the (pretrained) top-N trunk layers + final norm into the value-branch
+    params (parity with the reference's ModelBranch deepcopy of pretrained blocks,
+    modeling_ppo.py:523-533) so the value function starts from trunk features, not
+    random init. Leaves are host copies to avoid any buffer aliasing with the
+    (donated) trunk params."""
+    import numpy as np
+
+    copy_leaf = lambda x: np.array(jax.device_get(x))
+    p = dict(params)
+    start = config.num_layers - num_value_layers
+    for i in range(num_value_layers):
+        p[f"value_blocks_{i}"] = jax.tree.map(copy_leaf, params["transformer"][f"layers_{start + i}"])
+    if config.final_norm and "ln_f" in params["transformer"]:
+        p["value_ln"] = jax.tree.map(copy_leaf, params["transformer"]["ln_f"])
+    return p
 
 
 def branch_param_subtree(trunk_params: Dict[str, Any], start_layer: int, config: TransformerConfig) -> Dict[str, Any]:

@@ -406,15 +406,9 @@ class TransformerLM(nn.Module):
                 causal = jnp.logical_and(causal, attention_mask[:, None, None, :].astype(bool))
             mask_bias = jnp.where(causal, 0.0, -1e9).astype(jnp.float32)
         else:
+            default_positions, mask_bias = make_causal_bias(attention_mask, B, T)
             if positions is None:
-                if attention_mask is not None:
-                    positions = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0, None).astype(jnp.int32)
-                else:
-                    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
-            causal = jnp.tril(jnp.ones((T, T), dtype=bool))[None, None, :, :]
-            if attention_mask is not None:
-                causal = jnp.logical_and(causal, attention_mask[:, None, None, :].astype(bool))
-            mask_bias = jnp.where(causal, 0.0, -1e9).astype(jnp.float32)
+                positions = default_positions
 
         x = self.embed(input_ids, positions)
         kv_valid = attention_mask if cache is None else None
@@ -460,15 +454,9 @@ class TransformerLM(nn.Module):
         modeling_ppo.py:410-453) — called with the frozen param subtree via
         ``apply({"params": frozen}, ..., method="forward_from")``."""
         B, T, _ = hidden.shape
+        default_positions, mask_bias = make_causal_bias(attention_mask, B, T)
         if positions is None:
-            if attention_mask is not None:
-                positions = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0, None).astype(jnp.int32)
-            else:
-                positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
-        causal = jnp.tril(jnp.ones((T, T), dtype=bool))[None, None, :, :]
-        if attention_mask is not None:
-            causal = jnp.logical_and(causal, attention_mask[:, None, None, :].astype(bool))
-        mask_bias = jnp.where(causal, 0.0, -1e9).astype(jnp.float32)
+            positions = default_positions
         x = hidden
         for layer in self.layers[start_layer:]:
             x, _ = layer(x, mask_bias, positions, None, attention_mask)

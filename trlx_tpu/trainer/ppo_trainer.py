@@ -96,6 +96,11 @@ class PPOTrainer(MeshRLTrainer):
         if trunk_params is not None:
             params = dict(params)
             params["transformer"] = merge_loaded_params(params["transformer"], trunk_params)
+        n_value_layers = getattr(self.config.method, "num_value_layers_unfrozen", 0)
+        if n_value_layers > 0:
+            from trlx_tpu.models.policy import init_value_branch_from_trunk
+
+            params = init_value_branch_from_trunk(params, self.model_config, n_value_layers)
 
         shardings = make_param_shardings(params, self.mesh)
         self.params = jax.tree.map(
