@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Benchmark suite (parity: /root/reference/scripts/benchmark.sh): runs the
+# deterministic workloads and records metrics keyed by tree-hash via
+# trlx_tpu.reference. All workloads run offline.
+set -e
+cd "$(dirname "$0")/.."
+
+HPARAMS='{"train.total_steps": 64, "train.eval_interval": 16, "train.tracker": null}'
+
+echo "== randomwalks PPO =="
+python examples/randomwalks/ppo_randomwalks.py "$HPARAMS"
+echo "== randomwalks ILQL =="
+python examples/randomwalks/ilql_randomwalks.py "$HPARAMS"
+echo "== sentiments suite (short) =="
+for ex in ppo_sentiments ilql_sentiments sft_sentiments ppo_sentiments_t5; do
+  python examples/$ex.py "$HPARAMS"
+done
+echo "== throughput =="
+python -m trlx_tpu.reference run

@@ -21,3 +21,32 @@ __all__ = [
     "register_method",
     "get_method",
 ]
+
+# Generic element dataclasses (parity: /root/reference/trlx/data/__init__.py:7-34)
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+
+@dataclass
+class GeneralElement:
+    """General episode element (parity: data/__init__.py GeneralElement)."""
+
+    data: Any
+    metadata: dict = field(default_factory=dict)
+
+
+@dataclass
+class RLElement:
+    """State/action/reward triple."""
+
+    state: Any = None
+    action: Any = None
+    reward: float = 0.0
+
+
+@dataclass
+class BatchElement:
+    """Tokenized batch element."""
+
+    tokens: Any = None
+    masks: Any = None
