@@ -96,3 +96,32 @@ def test_state_dict_roundtrip(family):
     for k, v in sd2.items():
         assert k in sd, f"exported key {k} missing from HF state dict"
         np.testing.assert_allclose(v, sd[k], atol=1e-6, err_msg=k)
+
+
+def test_save_pretrained_roundtrip(tmp_path):
+    """HF export -> load_pretrained reproduces identical logits (the SFT->PPO
+    checkpoint hand-off path used by the randomwalks and summarize recipes)."""
+    import jax
+    from trlx_tpu.models.hf_loading import load_pretrained, save_pretrained_hf
+    from trlx_tpu.models.presets import PRESETS
+    from trlx_tpu.models.transformer import TransformerLM
+
+    config = PRESETS["gpt2"].replace(
+        vocab_size=61, hidden_size=32, num_layers=2, num_heads=4,
+        intermediate_size=96, max_position_embeddings=64, compute_dtype=jnp.float32,
+    )
+    model = TransformerLM(config)
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(1, 61, size=(2, 9)))
+    params = model.init(jax.random.PRNGKey(1), ids, jnp.ones_like(ids))["params"]
+    logits_before, *_ = model.apply({"params": params}, ids, jnp.ones_like(ids))
+
+    out = str(tmp_path / "export")
+    save_pretrained_hf(out, "gpt2", jax.device_get(params), config)
+    config2, params2, model_type = load_pretrained(out, overrides=dict(compute_dtype=jnp.float32))
+    assert model_type == "gpt2"
+    assert config2.intermediate_size == 96  # n_inner round-trips
+    logits_after, *_ = TransformerLM(config2).apply({"params": params2}, ids, jnp.ones_like(ids))
+    np.testing.assert_allclose(
+        np.asarray(logits_before), np.asarray(logits_after), atol=1e-5
+    )
