@@ -1,0 +1,43 @@
+"""Sweep CLI unit tests: trial generation strategies and result reporting."""
+
+import json
+
+from trlx_tpu.sweep import generate_trials
+
+
+def test_grid_trials():
+    cfg = {
+        "tune_config": {"search_alg": "grid"},
+        "train.seed": {"strategy": "choice", "values": [1, 2]},
+        "method.gamma": {"strategy": "choice", "values": [0.9, 0.99]},
+    }
+    trials = generate_trials(cfg)
+    assert len(trials) == 4
+    assert {json.dumps(t, sort_keys=True) for t in trials} == {
+        json.dumps(t, sort_keys=True)
+        for t in (
+            {"train.seed": 1, "method.gamma": 0.9},
+            {"train.seed": 1, "method.gamma": 0.99},
+            {"train.seed": 2, "method.gamma": 0.9},
+            {"train.seed": 2, "method.gamma": 0.99},
+        )
+    }
+
+
+def test_random_trials_strategies():
+    cfg = {
+        "tune_config": {"search_alg": "random", "num_samples": 16},
+        "method.init_kl_coef": {"strategy": "loguniform", "values": [1e-4, 1e-1]},
+        "optimizer.kwargs.lr": {"strategy": "uniform", "values": [1e-5, 1e-3]},
+        "train.seed": {"strategy": "int", "values": [0, 100]},
+        "train.batch_size": {"strategy": "choice", "values": [8, 16]},
+    }
+    trials = generate_trials(cfg, seed=1)
+    assert len(trials) == 16
+    for t in trials:
+        assert 1e-4 <= t["method.init_kl_coef"] <= 1e-1
+        assert 1e-5 <= t["optimizer.kwargs.lr"] <= 1e-3
+        assert 0 <= t["train.seed"] <= 100
+        assert t["train.batch_size"] in (8, 16)
+    # reproducible
+    assert generate_trials(cfg, seed=1) == trials
