@@ -72,9 +72,10 @@ def run_trials(script: str, trials: List[Dict[str, Any]], out_path: str, metric:
     for i, hparams in enumerate(trials):
         print(f"[sweep] trial {i + 1}/{len(trials)}: {hparams}", flush=True)
         t0 = time.time()
+        env = dict(os.environ, TRLX_SWEEP="1")
         proc = subprocess.run(
             [sys.executable, script, json.dumps(hparams)],
-            capture_output=True, text=True,
+            capture_output=True, text=True, env=env,
         )
         record = {
             "trial": i,

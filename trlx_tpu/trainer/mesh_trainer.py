@@ -414,9 +414,18 @@ class MeshRLTrainer(BaseRLTrainer):
 
                 if self.iter_count >= train_config.total_steps:
                     self.save(os.path.join(train_config.checkpoint_dir, f"checkpoint_{self.iter_count}"))
+                    self._report_sweep_result(results)
                     return results
             self.post_epoch_callback(epoch)
+        self._report_sweep_result(results)
         return results
+
+    def _report_sweep_result(self, results):
+        """Final-metrics line consumed by the sweep runner (trlx_tpu/sweep.py)."""
+        if os.environ.get("TRLX_SWEEP") and jax.process_index() == 0:
+            from trlx_tpu.utils import filter_non_scalars
+
+            print("SWEEP_RESULT " + json.dumps(filter_non_scalars(results or {})), flush=True)
 
     # ------------------------------------------------------------- checkpoints
 
