@@ -86,6 +86,9 @@ def pretrain_on_walks(config: TRLConfig, sample_walks, out_dir: str, steps: int 
         checkpoint_dir=out_dir + "/sft_ckpts",
     )
     d["optimizer"]["kwargs"]["lr"] = 1e-3
+    # pretraining always trains the full random-init model; layer-freezing hparams
+    # (e.g. num_layers_unfrozen for the PPO hydra stage) must not leak in here
+    d["model"]["num_layers_unfrozen"] = -1
     sft_config = TRLConfig.from_dict(d)
     trainer = trlx_tpu.train(samples=sample_walks, eval_prompts=["a"], config=sft_config)
     hf_dir = out_dir + "/sft_model"
