@@ -5,28 +5,18 @@ behavior untested (SURVEY.md §4). JAX lets us do better: every mesh/collective 
 path runs against 8 virtual CPU devices here.
 
 The session may pre-import jax pinned to a real TPU (via sitecustomize), so setting
-env vars is not enough — backends are reset after flipping the platform config.
+env vars is not enough — the shared reset recipe in ``__graft_entry__`` flips the
+platform config and resets backends before the first device query.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from __graft_entry__ import _force_cpu_platform  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
-try:
-    import jax.extend.backend
-
-    jax.extend.backend.clear_backends()
-except Exception:
-    pass
-assert jax.devices()[0].platform == "cpu", "tests must run on the virtual CPU platform"
-
+jax = _force_cpu_platform(8)
 jax.config.update("jax_default_matmul_precision", "float32")
 
 import pytest  # noqa: E402

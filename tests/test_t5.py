@@ -44,6 +44,44 @@ def test_t5_logits_match_hf(t5_pair):
     np.testing.assert_allclose(np.asarray(logits), hf_logits, atol=2e-3, rtol=1e-3)
 
 
+def test_t5_state_dict_roundtrip(t5_pair):
+    """params -> HF state dict -> params is exact, and exported tensors match the
+    HF originals (enables the seq2seq hf_model checkpoint export)."""
+    from trlx_tpu.models.hf_loading import params_to_hf_state_dict
+
+    hf_model, _, params, config = t5_pair
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    sd2 = params_to_hf_state_dict("t5", params, config)
+    for k, v in sd2.items():
+        if k in sd:
+            np.testing.assert_allclose(v, sd[k], atol=1e-6, err_msg=k)
+    params2 = t5_state_dict_to_params(sd2, config)
+    flat1 = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat2 = jax.tree_util.tree_flatten_with_path(params2)[0]
+    assert [p for p, _ in flat1] == [p for p, _ in flat2]
+    for (path, a), (_, b) in zip(flat1, flat2):
+        np.testing.assert_allclose(a, b, atol=1e-6, err_msg=str(path))
+
+
+def test_t5_save_pretrained_roundtrip(tmp_path, t5_pair):
+    """save_pretrained_hf('t5') exports an HF dir that load_pretrained_seq2seq
+    reloads to identical logits (the seq2seq checkpoint hand-off path)."""
+    from trlx_tpu.models.hf_loading import load_pretrained_seq2seq, save_pretrained_hf
+
+    _, model, params, config = t5_pair
+    out = str(tmp_path / "t5_export")
+    save_pretrained_hf(out, "t5", jax.device_get(params), config)
+    config2, params2 = load_pretrained_seq2seq(out, overrides=dict(compute_dtype=jnp.float32))
+    rng = np.random.default_rng(2)
+    enc_ids = jnp.asarray(rng.integers(2, 48, size=(2, 7)))
+    dec_ids = jnp.asarray(
+        np.concatenate([np.zeros((2, 1)), rng.integers(2, 48, size=(2, 4))], axis=1), jnp.int32
+    )
+    logits1, _, _ = model.apply({"params": params}, enc_ids, jnp.ones_like(enc_ids), dec_ids)
+    logits2, _, _ = T5LM(config2).apply({"params": params2}, enc_ids, jnp.ones_like(enc_ids), dec_ids)
+    np.testing.assert_allclose(np.asarray(logits1), np.asarray(logits2), atol=1e-5)
+
+
 def test_t5_cached_decode_matches_full(t5_pair):
     _, model, params, config = t5_pair
     rng = np.random.default_rng(1)

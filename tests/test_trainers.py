@@ -80,6 +80,40 @@ def test_ppo_end_to_end(tmp_path):
 
 
 @pytest.mark.slow
+def test_evaluate_mixed_prompt_buckets(tmp_path):
+    """Eval batches that bucket to different prompt lengths must each be decoded
+    with their own pad offset (regression: round-1 used the LAST batch's pad_len
+    for every batch, corrupting outputs of earlier batches)."""
+    from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
+    from trlx_tpu.utils.loading import get_trainer
+
+    captured = {}
+
+    def capture_reward(samples, prompts, outputs, **kw):
+        captured["prompts"] = list(prompts)
+        captured["outputs"] = list(outputs)
+        return [0.0] * len(samples)
+
+    config = TRLConfig(
+        method=SFTConfig(gen_kwargs=dict(max_new_tokens=4, do_sample=False)),
+        **base_kwargs(tmp_path, "SFTTrainer", batch_size=2),
+    )
+    trainer = get_trainer("SFTTrainer")(config=config, reward_fn=capture_reward)
+    short = ["ab", "cd"]       # bucket to prompt pad 8
+    long = ["abcdefgh ab", "cdefgh abc"]  # bucket to prompt pad 16
+    trainer.add_eval_pipeline(PromptPipeline(short + long, 32, trainer.tokenizer))
+    trainer.evaluate()
+    assert captured["prompts"] == short + long
+    mixed_outputs = captured["outputs"]
+
+    # greedy decoding: the short batch's outputs must be identical when the
+    # differently-bucketed long batch is absent
+    trainer.add_eval_pipeline(PromptPipeline(short, 32, trainer.tokenizer))
+    trainer.evaluate()
+    assert captured["outputs"] == mixed_outputs[:2]
+
+
+@pytest.mark.slow
 def test_ilql_end_to_end(tmp_path):
     config = TRLConfig(
         method=ILQLConfig(

@@ -358,6 +358,7 @@ CONVERTERS = {
     "gptj": (_gptj_to_params, _gptj_from_params),
     "opt": (_opt_to_params, _opt_from_params),
 }
+# "t5" is registered below once its converters are defined (seq2seq section)
 
 
 def hf_state_dict_to_params(model_type: str, sd: Dict[str, np.ndarray], config: TransformerConfig) -> Dict[str, Any]:
@@ -480,6 +481,18 @@ def make_hf_config(model_type: str, c: TransformerConfig):
             ffn_dim=c.ffn_dim, max_position_embeddings=c.max_position_embeddings,
             do_layer_norm_before=True,
         )
+    if model_type == "t5":
+        return transformers.T5Config(
+            vocab_size=c.vocab_size, d_model=c.d_model, d_kv=c.d_kv, d_ff=c.d_ff,
+            num_layers=c.num_layers, num_decoder_layers=c.num_decoder_layers,
+            num_heads=c.num_heads,
+            relative_attention_num_buckets=c.relative_attention_num_buckets,
+            relative_attention_max_distance=c.relative_attention_max_distance,
+            layer_norm_epsilon=c.layer_norm_epsilon,
+            feed_forward_proj="gated-gelu" if c.is_gated else "relu",
+            tie_word_embeddings=c.tie_word_embeddings,
+            decoder_start_token_id=c.decoder_start_token_id,
+        )
     raise ValueError(f"No HF config factory for {model_type!r}")
 
 
@@ -537,6 +550,52 @@ def t5_state_dict_to_params(sd: Dict[str, np.ndarray], config) -> Dict[str, Any]
             "mlp": _t5_ffn_to_params(sd, f"{pre}.layer.2.DenseReluDense", gated),
         }
     return jax.tree.map(lambda x: np.asarray(x, np.float32), p)
+
+
+def _t5_attn_from_params(p, pre, sd):
+    for k in ("q", "k", "v", "o"):
+        sd[f"{pre}.{k}.weight"] = p[k]["kernel"].T
+    if "relative_attention_bias" in p:
+        sd[f"{pre}.relative_attention_bias.weight"] = p["relative_attention_bias"]["embedding"]
+
+
+def _t5_ffn_from_params(p, pre, sd):
+    for name in ("wi", "wi_0", "wi_1", "wo"):
+        if name in p:
+            sd[f"{pre}.{name}.weight"] = p[name]["kernel"].T
+
+
+def _t5_from_params(p: Dict[str, Any], c) -> Dict[str, np.ndarray]:
+    """T5LM params -> HF T5 state dict (reverse of :func:`t5_state_dict_to_params`)."""
+    sd = {
+        "shared.weight": p["shared"]["embedding"],
+        "encoder.embed_tokens.weight": p["shared"]["embedding"],
+        "decoder.embed_tokens.weight": p["shared"]["embedding"],
+        "encoder.final_layer_norm.weight": p["encoder_ln"]["scale"],
+        "decoder.final_layer_norm.weight": p["decoder_ln"]["scale"],
+    }
+    if "lm_head" in p:
+        sd["lm_head.weight"] = p["lm_head"]["kernel"].T
+    for i in range(c.num_layers):
+        pre = f"encoder.block.{i}"
+        L = p[f"encoder_blocks_{i}"]
+        sd[f"{pre}.layer.0.layer_norm.weight"] = L["ln_1"]["scale"]
+        _t5_attn_from_params(L["attn"], f"{pre}.layer.0.SelfAttention", sd)
+        sd[f"{pre}.layer.1.layer_norm.weight"] = L["ln_2"]["scale"]
+        _t5_ffn_from_params(L["mlp"], f"{pre}.layer.1.DenseReluDense", sd)
+    for i in range(c.num_decoder_layers):
+        pre = f"decoder.block.{i}"
+        L = p[f"decoder_blocks_{i}"]
+        sd[f"{pre}.layer.0.layer_norm.weight"] = L["ln_1"]["scale"]
+        _t5_attn_from_params(L["self_attn"], f"{pre}.layer.0.SelfAttention", sd)
+        sd[f"{pre}.layer.1.layer_norm.weight"] = L["ln_cross"]["scale"]
+        _t5_attn_from_params(L["cross_attn"], f"{pre}.layer.1.EncDecAttention", sd)
+        sd[f"{pre}.layer.2.layer_norm.weight"] = L["ln_2"]["scale"]
+        _t5_ffn_from_params(L["mlp"], f"{pre}.layer.2.DenseReluDense", sd)
+    return sd
+
+
+CONVERTERS["t5"] = (t5_state_dict_to_params, _t5_from_params)
 
 
 def load_pretrained_seq2seq(model_path: str, overrides: Optional[Dict[str, Any]] = None):

@@ -291,22 +291,20 @@ class MeshRLTrainer(BaseRLTrainer):
 
         for sweep_kwargs in sweeps:
             suffix = "".join(f"@{k}={v}" for k, v in sweep_kwargs.items() if k in sweep_keys)
-            all_prompts, all_samples, all_masks, meta = [], [], [], {}
-            pad_len = None
+            # decode per batch with that batch's own prompt pad length: batches may
+            # bucket to different prompt lengths, so a shared pad_len would slice
+            # later batches' responses at the wrong offset
+            str_samples, str_prompts, str_outputs, meta = [], [], [], {}
             for batch in self.eval_pipeline.create_loader(self.config.train.batch_size):
                 prompts = batch["input_ids"]
-                samples, resp_mask, pad_len = self.generate(prompts, eval_mode=True, **sweep_kwargs)
-                all_prompts.extend(prompts)
-                all_samples.append(samples)
-                all_masks.append(resp_mask)
+                samples, _resp_mask, pad_len = self.generate(prompts, eval_mode=True, **sweep_kwargs)
+                s, p, o, _ = self.decode(prompts, samples, pad_len)
+                str_samples.extend(s)
+                str_prompts.extend(p)
+                str_outputs.extend(o)
                 for k, v in batch.items():
                     if k != "input_ids":
                         meta.setdefault(k, []).extend(v)
-            R = max(s.shape[1] for s in all_samples)
-            samples = np.concatenate(
-                [np.pad(s, ((0, 0), (0, R - s.shape[1])), constant_values=self.tokenizer.pad_token_id) for s in all_samples]
-            )
-            str_samples, str_prompts, str_outputs, _ = self.decode(all_prompts, samples, pad_len)
 
             columns = ["prompt", "output"]
             columns_data = [str_prompts, str_outputs]
@@ -473,7 +471,8 @@ class MeshRLTrainer(BaseRLTrainer):
         from trlx_tpu.models.hf_loading import save_pretrained_hf
 
         params = jax.device_get(self.params)
-        trunk = params.get("transformer", params)
+        trunk_key = "transformer" if "transformer" in params else ("t5" if "t5" in params else None)
+        trunk = params[trunk_key] if trunk_key else params
         if getattr(self.model_config, "lora_r", 0):
             from trlx_tpu.models.transformer import merge_lora_params
 
@@ -484,7 +483,7 @@ class MeshRLTrainer(BaseRLTrainer):
                 save_pretrained_hf(directory, self.model_type, trunk, self.model_config)
             except Exception as e:
                 logger.warning(f"HF export unavailable ({e}); saving native params only")
-            heads = {k: v for k, v in params.items() if k != "transformer"}
+            heads = {k: v for k, v in params.items() if k != trunk_key}
             if heads:
                 with open(os.path.join(directory, "heads.msgpack"), "wb") as f:
                     f.write(to_bytes(heads))

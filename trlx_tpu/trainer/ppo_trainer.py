@@ -361,8 +361,10 @@ class PPOTrainer(MeshRLTrainer):
 
             # per-token KL penalty & reward assembly (parity: :457-492)
             log_ratio = (logprobs - ref_logprobs) * r_mask
-            kl_per_token = np.exp(-log_ratio) - 1.0 + log_ratio  # k3 estimator
-            mean_kl = (kl_per_token.sum(axis=1) / np.maximum(r_mask.sum(axis=1), 1)).mean()
+            kl_per_token = np.exp(log_ratio) - 1.0 - log_ratio  # k3 estimator (:461)
+            # controller sees the per-SEQUENCE kl sum (reference :460 kl.sum(1).mean());
+            # the shipped AdaptiveKL targets (e.g. 6.0) are calibrated to that scale
+            mean_kl = kl_per_token.sum(axis=1).mean()
             accumulated_kl.append(mean_kl)
 
             kl_coef = self.kl_ctl.value
