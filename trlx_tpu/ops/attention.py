@@ -10,9 +10,11 @@ recomputes attention in XLA (memory-efficient forward is what matters for the ro
 path; training can additionally remat).
 
 Masking model matches :mod:`trlx_tpu.models.transformer`: slot-based causality plus a
-[B, S] key-validity mask (left-padded prompts). Engaged on the cache-free forwards —
-the training loss and the logprob/value scoring passes; cached generation
-prefill/decode stays on the XLA path (it must materialize K/V into the cache anyway).
+[B, S] key-validity mask (left-padded prompts). Engaged on every multi-token forward:
+the training loss, the logprob/value scoring passes, and generation *prefill* (which
+attends over the just-computed prefix k/v while the cache write happens separately).
+Only single-token decode steps stay on the XLA path. Arbitrary T/S are supported via
+internal padding + block selection (see ``_flash_forward``).
 """
 
 import functools
@@ -94,6 +96,12 @@ def _flash_kernel(
         o_ref[0, 0, ...] = (acc_scratch[...] / safe_l).astype(o_ref.dtype)
 
 
+def _pick_block(n: int, max_block: int) -> int:
+    """Largest multiple-of-8 block <= max_block dividing ceil8(n) (min padding)."""
+    n8 = -(-n // 8) * 8
+    return max(b for b in range(8, min(max_block, n8) + 1, 8) if n8 % b == 0)
+
+
 def _flash_forward(
     q: jnp.ndarray,  # [B, H, T, D]
     k: jnp.ndarray,  # [B, H, S, D]
@@ -107,8 +115,27 @@ def _flash_forward(
 ) -> jnp.ndarray:
     B, H, T, D = q.shape
     S = k.shape[2]
-    block_q = min(block_q, T)
-    block_k = min(block_k, S)
+    # any T/S supported: pad to a sublane multiple and pick the largest block
+    # (<= requested) that divides the padded length — e.g. T=144 (P16+R128) runs
+    # at block 72 with no extra padding. Padded keys are masked via kv_valid;
+    # padded query rows are sliced off. This lets the kernel cover prefill and
+    # mixed P+R training shapes.
+    block_q = _pick_block(T, block_q)
+    block_k = _pick_block(S, block_k)
+    pad_t = -T % block_q
+    pad_s = -S % block_k
+    if pad_t or pad_s:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_t), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_s), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_s), (0, 0)))
+        kv_valid = jnp.pad(kv_valid, ((0, 0), (0, pad_s)))
+    out = _flash_padded(q, k, v, kv_valid, causal, scale, block_q, block_k, interpret)
+    return out[:, :, :T, :] if pad_t else out
+
+
+def _flash_padded(q, k, v, kv_valid, causal, scale, block_q, block_k, interpret):
+    B, H, T, D = q.shape
+    S = k.shape[2]
     assert T % block_q == 0 and S % block_k == 0, (T, S, block_q, block_k)
     kv_steps = S // block_k
     grid = (B, H, T // block_q, kv_steps)

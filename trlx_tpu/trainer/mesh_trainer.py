@@ -247,24 +247,69 @@ class MeshRLTrainer(BaseRLTrainer):
         samples: np.ndarray,
         prompt_pad_len: int,
         append_eos: bool = False,
+        response_masks: Optional[np.ndarray] = None,
     ) -> Tuple[List[str], List[str], List[str], List[np.ndarray]]:
         """Decode generated sequences into (str_samples, str_prompts, str_outputs,
         trimmed_output_ids), trimming at the first stop sequence and (optionally)
-        re-appending eos (parity: accelerate_base_trainer.py:203-255)."""
+        re-appending eos (parity: accelerate_base_trainer.py:203-255).
+
+        Trimming is token-level on the rollout hot path: response lengths come from
+        the generation ``response_mask`` and stop sequences are found by token-
+        subsequence scan (native ``find_stop_positions``), so output ids are sliced
+        from the sampled tokens without re-tokenization. A string-level check
+        remains only as a net for stop sequences that cross token boundaries."""
+        from trlx_tpu.native import find_stop_positions
+
+        B = len(prompts)
+        resp_all = np.ascontiguousarray(samples[:, prompt_pad_len:], np.int32)
+        eos = self.tokenizer.eos_token_id
+        pad = self.tokenizer.pad_token_id
+        if response_masks is not None:
+            lens = np.asarray(response_masks).sum(axis=1).astype(np.int64)
+        else:
+            valid = resp_all != pad
+            lens = np.where(
+                valid.any(axis=1), resp_all.shape[1] - np.argmax(valid[:, ::-1], axis=1), 0
+            ).astype(np.int64)
+        # response_mask counts the eos token itself; output ids exclude it
+        if eos is not None and B > 0:
+            last = resp_all[np.arange(B), np.maximum(lens - 1, 0)]
+            lens = lens - ((lens > 0) & (last == eos)).astype(np.int64)
+        token_stopped = np.zeros(B, bool)
+        if self.stop_sequences:
+            if not hasattr(self, "_stop_token_ids"):
+                self._stop_token_ids = [
+                    self.tokenizer(s, add_special_tokens=False).input_ids
+                    for s in self.stop_sequences
+                ]
+            stop_pos = find_stop_positions(resp_all, self._stop_token_ids)
+            token_stopped = stop_pos < lens
+            lens = np.minimum(lens, stop_pos)
+
         str_samples, str_prompts, str_outputs, out_ids = [], [], [], []
         for i, prompt in enumerate(prompts):
             str_prompt = self.tokenizer.decode(prompt, skip_special_tokens=True)
-            resp = samples[i, prompt_pad_len:]
+            resp = resp_all[i, : lens[i]]
+            if token_stopped[i]:
+                # parity with the reference's str_output[:ix].rstrip(): drop the
+                # whitespace run preceding the stop sequence (token-level)
+                while len(resp) and self.tokenizer.decode(resp[-1:]).strip() == "":
+                    resp = resp[:-1]
             str_output = self.tokenizer.decode(resp, skip_special_tokens=True)
+            if token_stopped[i]:
+                str_output = str_output.rstrip()
             for stop in self.stop_sequences:
                 stop_ix = str_output.find(stop)
-                if stop_ix >= 0:
+                if stop_ix >= 0:  # crossed a token boundary; rare slow path
                     str_output = str_output[:stop_ix].rstrip()
-            trimmed = self.tokenizer(str_output).input_ids
-            if append_eos and self.tokenizer.eos_token_id is not None:
-                trimmed = list(trimmed) + [self.tokenizer.eos_token_id]
+                    resp = np.asarray(
+                        self.tokenizer(str_output, add_special_tokens=False).input_ids, np.int32
+                    )
+            trimmed = list(resp)
+            if append_eos and eos is not None:
+                trimmed.append(eos)
             if len(trimmed) == 0:  # never emit empty responses (breaks PPO shapes)
-                trimmed = [self.tokenizer.eos_token_id or 0]
+                trimmed = [eos or 0]
             str_samples.append(str_prompt + str_output)
             str_prompts.append(str_prompt)
             str_outputs.append(str_output)
@@ -297,8 +342,8 @@ class MeshRLTrainer(BaseRLTrainer):
             str_samples, str_prompts, str_outputs, meta = [], [], [], {}
             for batch in self.eval_pipeline.create_loader(self.config.train.batch_size):
                 prompts = batch["input_ids"]
-                samples, _resp_mask, pad_len = self.generate(prompts, eval_mode=True, **sweep_kwargs)
-                s, p, o, _ = self.decode(prompts, samples, pad_len)
+                samples, resp_mask, pad_len = self.generate(prompts, eval_mode=True, **sweep_kwargs)
+                s, p, o, _ = self.decode(prompts, samples, pad_len, response_masks=resp_mask)
                 str_samples.extend(s)
                 str_prompts.extend(p)
                 str_outputs.extend(o)

@@ -298,7 +298,7 @@ class PPOTrainer(MeshRLTrainer):
 
             samples, resp_mask, pad_len = self.generate(prompts, eval_mode=False)
             str_samples, str_prompts, str_outputs, out_ids = self.decode(
-                prompts, samples, pad_len, append_eos=True
+                prompts, samples, pad_len, append_eos=True, response_masks=resp_mask
             )
 
             scores = self.reward_fn(

@@ -56,7 +56,9 @@ class RFTTrainer(SFTTrainer):
                 prompts = batch["input_ids"]
                 for _ in range(method.n_generations_per_prompt):
                     samples, resp_mask, pad_len = self.generate(prompts, eval_mode=True)
-                    _, str_prompts, str_outputs, _ = self.decode(prompts, samples, pad_len, append_eos=True)
+                    _, str_prompts, str_outputs, _ = self.decode(
+                        prompts, samples, pad_len, append_eos=True, response_masks=resp_mask
+                    )
                     generations.extend(
                         {"prompt": p, "output": o} for p, o in zip(str_prompts, str_outputs)
                     )
