@@ -26,7 +26,23 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from trlx_tpu.parallel.mesh import BATCH_AXES, MODEL_AXIS
+from trlx_tpu.parallel.sharding import (
+    ambient_mesh,
+    batch_divisible,
+    constrain_gathered,
+    constrain_seq,
+)
+
 KVCache = Dict[str, Any]  # {"k": [L,B,Hkv,S,D], "v": [L,B,Hkv,S,D], "index": i32[]}
+
+
+def _concrete_zero(x) -> bool:
+    """True iff ``x`` is a concrete (non-traced) scalar equal to 0."""
+    try:
+        return int(x) == 0
+    except Exception:  # jax TracerError and friends
+        return False
 
 
 @dataclass(frozen=True)
@@ -65,7 +81,11 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     remat: str = "none"  # "none" | "full" | "nothing_saveable" | "dots_saveable"
-    attention_impl: str = "xla"  # "xla" | "flash" (Pallas kernel for prefill/training)
+    attention_impl: str = "xla"  # "xla" | "flash" (Pallas) | "ring" (sequence-parallel)
+    # Megatron-SP analogue: shard the residual stream's sequence dim over the
+    # `model` axis between blocks (reference sequence_parallel cfg,
+    # modeling_nemo_ppo.py:160-164). Applied on cache-free forwards.
+    sequence_sharding: bool = False
 
     # LoRA adapters (native peft equivalent; reference uses the peft library —
     # modeling_base.py:162-240). r=0 disables.
@@ -257,8 +277,16 @@ class Attention(nn.Module):
         # (cache present, T > 1, writes starting at slot 0) attention over the
         # just-computed prefix k/v is exactly attention over the cache, since all
         # cache slots >= T are still empty; k/v are written to the cache above
-        # regardless. Single-token decode steps read the full cache via XLA.
-        use_flash = c.attention_impl == "flash" and kv_valid is not None and T > 1
+        # regardless. The slot-0 requirement is enforced structurally: the cache
+        # index must be a concrete 0 at trace time (true for generate()'s prefill,
+        # never true inside the decode while_loop or for chunked appends, which
+        # fall back to attending over the full cache via XLA).
+        use_flash = (
+            c.attention_impl == "flash"
+            and kv_valid is not None
+            and T > 1
+            and (cache is None or _concrete_zero(cache["index"]))
+        )
         if cache is not None and not use_flash:
             k, v = ck, cv  # attend over the cache (decode step / XLA prefill)
 
@@ -269,6 +297,22 @@ class Attention(nn.Module):
             v = jnp.repeat(v, rep, axis=2)
 
         scale = 1.0 / math.sqrt(c.dim_per_head)
+        if c.attention_impl == "ring" and cache is None and kv_valid is not None:
+            from trlx_tpu.ops.ring_attention import ring_attention
+
+            mesh = ambient_mesh()
+            n = mesh.shape.get(MODEL_AXIS, 1) if mesh is not None else 1
+            if mesh is not None and n > 1 and T % n == 0 and batch_divisible(mesh, B):
+                out = ring_attention(
+                    q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+                    mesh, axis_name=MODEL_AXIS, causal=True, scale=scale,
+                    kv_valid=kv_valid, batch_axes=BATCH_AXES,
+                ).transpose(0, 2, 1, 3).astype(c.compute_dtype)
+                out = out.reshape(B, T, c.num_heads * c.dim_per_head)
+                out = dense(c.hidden_size, "o_proj", c.attn_bias)(out)
+                return out, new_cache
+            # fall through to XLA when the mesh/shape can't ring
+
         if use_flash:
             from trlx_tpu.ops.attention import flash_attention
             out = flash_attention(
@@ -424,6 +468,9 @@ class TransformerLM(nn.Module):
         capture_set = ()
         if branch_layer is not None:
             capture_set = branch_layer if isinstance(branch_layer, tuple) else (branch_layer,)
+        seq_shard = c.sequence_sharding and cache is None
+        if seq_shard:
+            x = constrain_seq(x)
         captures = {}
         branch_hidden = None
         new_layer_caches = []
@@ -434,8 +481,14 @@ class TransformerLM(nn.Module):
             if cache is not None:
                 layer_cache = {"k": cache["k"][i], "v": cache["v"][i], "index": cache["index"]}
             x, new_lc = layer(x, mask_bias, positions, layer_cache, kv_valid)
+            if seq_shard:
+                x = constrain_seq(x)
             if cache is not None:
                 new_layer_caches.append(new_lc)
+        if seq_shard:
+            # gather the sequence dim before heads (Megatron's
+            # gather_from_sequence_parallel_region analogue)
+            x = constrain_gathered(x)
         logits, hidden = self._final(x)
         new_cache = None
         if cache is not None:
@@ -468,6 +521,8 @@ class TransformerLM(nn.Module):
         x = hidden
         for layer in self.layers[start_layer:]:
             x, _ = layer(x, mask_bias, positions, None, attention_mask)
+            if self.config.sequence_sharding:
+                x = constrain_seq(x)
         logits, _ = self._final(x)
         return logits
 

@@ -17,7 +17,7 @@ source > i.
 
 import functools
 import math
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -31,10 +31,10 @@ def _block_attn(q, k, v, m, l, acc, scale, mask):
     """One online-softmax accumulation step.
 
     q [B,H,Tq,D]; k/v [B,H,Tk,D]; m/l [B,H,Tq,1]; acc [B,H,Tq,D];
-    mask [Tq,Tk] bool or None (True = attend)."""
+    mask bool broadcastable to [B,H,Tq,Tk] or None (True = attend)."""
     s = jnp.einsum("bhtd,bhsd->bhts", q, k).astype(jnp.float32) * scale
     if mask is not None:
-        s = jnp.where(mask[None, None], s, NEG_INF)
+        s = jnp.where(mask, s, NEG_INF)
     m_cur = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m, m_cur)
     p = jnp.where(m_new > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
@@ -52,50 +52,56 @@ def ring_attention(
     axis_name: str = "model",
     causal: bool = True,
     scale: Optional[float] = None,
+    kv_valid: Optional[jnp.ndarray] = None,
+    batch_axes: Optional[Any] = None,
 ) -> jnp.ndarray:
     """Sequence-parallel attention. q/k/v: [B, H, S, D] with S sharded over
-    ``axis_name`` (batch/head dims replicated or sharded elsewhere). Returns the
-    attention output with the same sharding as q."""
+    ``axis_name`` (batch dim sharded per ``batch_axes``, head dim replicated).
+    ``kv_valid`` [B, S] masks out padding keys (left-padded prompts); it rides
+    the ring alongside K/V. Returns the attention output sharded like q.
+
+    Each step computes ONE online-softmax block: the shard-granularity causal
+    structure (full / diagonal / skip) is folded into the block's mask instead of
+    computing masked and unmasked variants and selecting afterwards."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     n = mesh.shape[axis_name]
+    if kv_valid is None:
+        kv_valid = jnp.ones((q.shape[0], k.shape[2]), jnp.int32)
 
-    def local_fn(q_loc, k_loc, v_loc):
+    def local_fn(q_loc, k_loc, v_loc, valid_loc):
         B, H, T, D = q_loc.shape
         my = jax.lax.axis_index(axis_name)
         tri = jnp.tril(jnp.ones((T, T), dtype=bool))
 
         def body(step, carry):
-            k_cur, v_cur, m, l, acc = carry
+            k_cur, v_cur, valid_cur, m, l, acc = carry
             src = (my - step) % n
-            # contribution mask at shard granularity
-            full = src < my
-            diag = src == my
-            m2, l2, acc2 = _block_attn(
-                q_loc, k_cur, v_cur, m, l, acc, scale,
-                mask=tri if causal else None,
-            )
-            mf, lf, accf = _block_attn(q_loc, k_cur, v_cur, m, l, acc, scale, mask=None)
+            # shard-granularity causal structure folded into one mask:
+            # src < my -> attend fully; src == my -> within-shard causal;
+            # src > my -> contribute nothing
+            mask = valid_cur[:, None, None, :] > 0  # [B,1,1,Tk]
             if causal:
-                use_diag = diag
-                use_full = full
-                m_new = jnp.where(use_diag, m2, jnp.where(use_full, mf, m))
-                l_new = jnp.where(use_diag, l2, jnp.where(use_full, lf, l))
-                acc_new = jnp.where(use_diag, acc2, jnp.where(use_full, accf, acc))
-            else:
-                m_new, l_new, acc_new = mf, lf, accf
+                shard_mask = jnp.logical_or(src < my, jnp.logical_and(src == my, tri))
+                mask = jnp.logical_and(mask, shard_mask[None, None])
+            m, l, acc = _block_attn(q_loc, k_cur, v_cur, m, l, acc, scale, mask)
             perm = [(i, (i + 1) % n) for i in range(n)]
             k_next = jax.lax.ppermute(k_cur, axis_name, perm)
             v_next = jax.lax.ppermute(v_cur, axis_name, perm)
-            return (k_next, v_next, m_new, l_new, acc_new)
+            valid_next = jax.lax.ppermute(valid_cur, axis_name, perm)
+            return (k_next, v_next, valid_next, m, l, acc)
 
         m0 = jnp.full((B, H, T, 1), NEG_INF, jnp.float32)
         l0 = jnp.zeros((B, H, T, 1), jnp.float32)
         acc0 = jnp.zeros((B, H, T, D), jnp.float32)
-        _, _, m, l, acc = jax.lax.fori_loop(0, n, body, (k_loc, v_loc, m0, l0, acc0))
+        _, _, _, m, l, acc = jax.lax.fori_loop(
+            0, n, body, (k_loc, v_loc, valid_loc, m0, l0, acc0)
+        )
         safe_l = jnp.where(l == 0.0, 1.0, l)
         return (acc / safe_l).astype(q_loc.dtype)
 
-    spec = P(None, None, axis_name, None)
+    spec = P(batch_axes, None, axis_name, None)
+    vspec = P(batch_axes, axis_name)
     return shard_map(
-        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_rep=False
-    )(q, k, v)
+        local_fn, mesh=mesh, in_specs=(spec, spec, spec, vspec), out_specs=spec,
+        check_rep=False,
+    )(q, k, v, kv_valid.astype(jnp.int32))

@@ -14,7 +14,8 @@ from trlx_tpu.parallel.mesh import (
     replicated,
 )
 from trlx_tpu.parallel.sharding import (
-    constrain,
+    ambient_mesh,
+    constrain_seq,
     default_lm_rules,
     make_param_shardings,
     make_param_specs,

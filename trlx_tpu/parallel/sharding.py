@@ -119,6 +119,65 @@ def shard_params(params: Any, mesh: Mesh, rules: Optional[Sequence[Rule]] = None
     return jax.tree.map(jax.device_put, params, shardings)
 
 
-def constrain(x: jax.Array, mesh: Mesh, *spec_entries) -> jax.Array:
-    """``with_sharding_constraint`` shorthand usable inside jitted code."""
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, PartitionSpec(*spec_entries)))
+_warned_no_mesh_api = False
+
+
+def ambient_mesh() -> Optional[Mesh]:
+    """The mesh of the enclosing ``with mesh:`` context, or None."""
+    global _warned_no_mesh_api
+    try:
+        from jax._src import mesh as _mesh_lib
+
+        m = _mesh_lib.thread_resources.env.physical_mesh
+        return None if m.empty else m
+    except Exception:
+        if not _warned_no_mesh_api:
+            _warned_no_mesh_api = True
+            logger.warning(
+                "Could not read the ambient mesh (jax internals moved?): ring "
+                "attention and sequence sharding are DISABLED. Update "
+                "trlx_tpu.parallel.sharding.ambient_mesh for this jax version."
+            )
+        return None
+
+
+def batch_divisible(mesh: Mesh, batch_size: int) -> bool:
+    """Whether a leading batch dim can shard evenly over the combined data axes."""
+    from trlx_tpu.parallel.mesh import BATCH_AXES
+
+    return batch_size % int(np.prod([mesh.shape.get(a, 1) for a in BATCH_AXES])) == 0
+
+
+def constrain_gathered(x: jax.Array) -> jax.Array:
+    """Gather the sequence dim back before the LM/value heads (the analogue of
+    Megatron's ``gather_from_sequence_parallel_region``, reference
+    modeling_nemo_ppo.py:160-164): batch stays sharded, everything else whole."""
+    mesh = ambient_mesh()
+    if mesh is None or not batch_divisible(mesh, x.shape[0]):
+        return x
+    from trlx_tpu.parallel.mesh import BATCH_AXES
+
+    entries = [None] * x.ndim
+    entries[0] = BATCH_AXES
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, PartitionSpec(*entries)))
+
+
+def constrain_seq(x: jax.Array, seq_dim: int = 1) -> jax.Array:
+    """Sequence-parallel activation constraint (Megatron-SP analogue,
+    reference modeling_nemo_ppo.py:160-164): shard the sequence dim of an
+    activation over the ``model`` axis (batch over the data axes). XLA inserts
+    the all-gather before TP matmuls and the reduce-scatter after, which is
+    exactly Megatron SP's gather/scatter pair. No-op outside a mesh context or
+    when the sequence length does not divide the axis."""
+    mesh = ambient_mesh()
+    if mesh is None:
+        return x
+    size = mesh.shape.get(MODEL_AXIS, 1)
+    if size <= 1 or x.shape[seq_dim] % size != 0 or not batch_divisible(mesh, x.shape[0]):
+        return x
+    from trlx_tpu.parallel.mesh import BATCH_AXES
+
+    entries = [None] * x.ndim
+    entries[0] = BATCH_AXES
+    entries[seq_dim] = MODEL_AXIS
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, PartitionSpec(*entries)))

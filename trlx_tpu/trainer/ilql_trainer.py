@@ -96,6 +96,7 @@ class ILQLTrainer(MeshRLTrainer):
             self._setup_seq2seq_model(overrides)
             return
         overrides.setdefault("remat", self.config.mesh.remat)
+        overrides.setdefault("sequence_sharding", self.config.mesh.sequence_shard)
         from trlx_tpu.models.hf_loading import merge_loaded_params, peft_overrides
 
         overrides.update(peft_overrides(self.config.model.peft_config))

@@ -38,6 +38,7 @@ class SFTTrainer(MeshRLTrainer):
         overrides.setdefault("param_dtype", self.param_dtype)
         overrides.setdefault("compute_dtype", self.compute_dtype)
         overrides.setdefault("remat", self.config.mesh.remat)
+        overrides.setdefault("sequence_sharding", self.config.mesh.sequence_shard)
         from trlx_tpu.models.hf_loading import init_params, merge_loaded_params, peft_overrides
 
         overrides.update(peft_overrides(self.config.model.peft_config))
