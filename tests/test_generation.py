@@ -64,6 +64,31 @@ def test_cached_greedy_matches_naive(tiny_model):
     np.testing.assert_array_equal(got, expected[len(prompt):])
 
 
+@pytest.mark.parametrize("family", ["bloom", "gpt_bigcode"])
+def test_cached_greedy_matches_naive_new_families(family):
+    """ALiBi (bloom) and MQA (gpt_bigcode) must decode identically through the
+    KV-cache path and the full re-forward path."""
+    config = PRESETS[family].replace(
+        vocab_size=48, hidden_size=32, num_layers=2, num_heads=4,
+        max_position_embeddings=64, compute_dtype=jnp.float32,
+    )
+    model = TransformerLM(config)
+    params = model.init(jax.random.PRNGKey(1), jnp.ones((1, 4), jnp.int32),
+                        jnp.ones((1, 4), jnp.int32))["params"]
+    prompt = np.array([5, 9, 11, 2, 30], np.int32)
+    n_new = 6
+    expected = naive_greedy(model, params, prompt, n_new)
+
+    ids, mask = left_pad_batch([prompt], pad_token_id=0, target_len=8)
+    out = generate(
+        model_step_fn(model), params, lambda b, s: model.init_cache(b, s, jnp.float32),
+        jnp.asarray(ids), jnp.asarray(mask), jax.random.PRNGKey(0),
+        max_new_tokens=n_new, do_sample=False, pad_token_id=0,
+    )
+    got = np.asarray(out["sequences"])[0, 8:]
+    np.testing.assert_array_equal(got, expected[len(prompt):])
+
+
 def test_left_padded_batch_generation_consistent(tiny_model):
     """Each sample in a ragged left-padded batch decodes the same as alone."""
     model, params, config = tiny_model

@@ -64,10 +64,24 @@ def make_hf_model(family):
             dropout=0.0, do_layer_norm_before=True, word_embed_proj_dim=TINY["hidden"],
         )
         return transformers.OPTForCausalLM(config)
+    if family == "bloom":
+        config = transformers.BloomConfig(
+            vocab_size=TINY["vocab"], hidden_size=TINY["hidden"], n_layer=TINY["layers"],
+            n_head=TINY["heads"], attention_dropout=0.0, hidden_dropout=0.0,
+        )
+        return transformers.BloomForCausalLM(config)
+    if family == "gpt_bigcode":
+        config = transformers.GPTBigCodeConfig(
+            vocab_size=TINY["vocab"], n_embd=TINY["hidden"], n_layer=TINY["layers"],
+            n_head=TINY["heads"], n_positions=TINY["positions"], multi_query=True,
+            activation_function="gelu_pytorch_tanh",
+            attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0,
+        )
+        return transformers.GPTBigCodeForCausalLM(config)
     raise ValueError(family)
 
 
-@pytest.mark.parametrize("family", ["gpt2", "llama", "gpt_neox", "gptj", "opt"])
+@pytest.mark.parametrize("family", ["gpt2", "llama", "gpt_neox", "gptj", "opt", "bloom", "gpt_bigcode"])
 def test_logits_match_hf(family):
     hf_model = make_hf_model(family).eval()
     sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
@@ -86,7 +100,7 @@ def test_logits_match_hf(family):
     np.testing.assert_allclose(np.asarray(logits), hf_logits, atol=2e-3, rtol=1e-3)
 
 
-@pytest.mark.parametrize("family", ["gpt2", "llama", "gpt_neox", "gptj", "opt"])
+@pytest.mark.parametrize("family", ["gpt2", "llama", "gpt_neox", "gptj", "opt", "bloom", "gpt_bigcode"])
 def test_state_dict_roundtrip(family):
     hf_model = make_hf_model(family)
     sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}

@@ -351,12 +351,147 @@ def _opt_from_params(p, c):
     return sd
 
 
+def _bloom_to_params(sd, c):
+    """Bloom: ALiBi, embedding LN, per-head-interleaved fused qkv (like neox)."""
+    pre0 = "transformer." if "transformer.word_embeddings.weight" in sd else ""
+    p = {
+        "embed_tokens": {"embedding": sd[f"{pre0}word_embeddings.weight"]},
+        "embed_layernorm": _ln(sd, f"{pre0}word_embeddings_layernorm"),
+        "ln_f": _ln(sd, f"{pre0}ln_f"),
+    }
+    heads, hd, H = c.num_heads, c.dim_per_head, c.hidden_size
+    for i in range(c.num_layers):
+        pre = f"{pre0}h.{i}"
+        qkv_w = sd[f"{pre}.self_attention.query_key_value.weight"]  # [3H, H]
+        qkv_b = sd[f"{pre}.self_attention.query_key_value.bias"]
+        w = qkv_w.reshape(heads, 3, hd, H)
+        b = qkv_b.reshape(heads, 3, hd)
+        mk_w = lambda j: w[:, j].reshape(heads * hd, H).T
+        mk_b = lambda j: b[:, j].reshape(heads * hd)
+        p[f"layers_{i}"] = {
+            "ln_1": _ln(sd, f"{pre}.input_layernorm"),
+            "ln_2": _ln(sd, f"{pre}.post_attention_layernorm"),
+            "attn": {
+                "q_proj": {"kernel": mk_w(0), "bias": mk_b(0)},
+                "k_proj": {"kernel": mk_w(1), "bias": mk_b(1)},
+                "v_proj": {"kernel": mk_w(2), "bias": mk_b(2)},
+                "o_proj": _linear(sd, f"{pre}.self_attention.dense"),
+            },
+            "mlp": {
+                "up_proj": _linear(sd, f"{pre}.mlp.dense_h_to_4h"),
+                "down_proj": _linear(sd, f"{pre}.mlp.dense_4h_to_h"),
+            },
+        }
+    return p
+
+
+def _bloom_from_params(p, c):
+    sd = {
+        "transformer.word_embeddings.weight": p["embed_tokens"]["embedding"],
+        "transformer.word_embeddings_layernorm.weight": p["embed_layernorm"]["scale"],
+        "transformer.word_embeddings_layernorm.bias": p["embed_layernorm"]["bias"],
+        "transformer.ln_f.weight": p["ln_f"]["scale"],
+        "transformer.ln_f.bias": p["ln_f"]["bias"],
+    }
+    heads, hd, H = c.num_heads, c.dim_per_head, c.hidden_size
+    for i in range(c.num_layers):
+        L = p[f"layers_{i}"]
+        pre = f"transformer.h.{i}"
+        sd[f"{pre}.input_layernorm.weight"] = L["ln_1"]["scale"]
+        sd[f"{pre}.input_layernorm.bias"] = L["ln_1"]["bias"]
+        sd[f"{pre}.post_attention_layernorm.weight"] = L["ln_2"]["scale"]
+        sd[f"{pre}.post_attention_layernorm.bias"] = L["ln_2"]["bias"]
+        ws = [L["attn"][k]["kernel"].T.reshape(heads, hd, H) for k in ("q_proj", "k_proj", "v_proj")]
+        bs = [L["attn"][k]["bias"].reshape(heads, hd) for k in ("q_proj", "k_proj", "v_proj")]
+        sd[f"{pre}.self_attention.query_key_value.weight"] = np.stack(ws, axis=1).reshape(3 * H, H)
+        sd[f"{pre}.self_attention.query_key_value.bias"] = np.stack(bs, axis=1).reshape(3 * H)
+        sd[f"{pre}.self_attention.dense.weight"] = L["attn"]["o_proj"]["kernel"].T
+        sd[f"{pre}.self_attention.dense.bias"] = L["attn"]["o_proj"]["bias"]
+        sd[f"{pre}.mlp.dense_h_to_4h.weight"] = L["mlp"]["up_proj"]["kernel"].T
+        sd[f"{pre}.mlp.dense_h_to_4h.bias"] = L["mlp"]["up_proj"]["bias"]
+        sd[f"{pre}.mlp.dense_4h_to_h.weight"] = L["mlp"]["down_proj"]["kernel"].T
+        sd[f"{pre}.mlp.dense_4h_to_h.bias"] = L["mlp"]["down_proj"]["bias"]
+    return sd
+
+
+def _bigcode_to_params(sd, c):
+    """GPTBigCode: multi-query attention — c_attn packs [q(H) | k(hd) | v(hd)].
+
+    Only the MQA layout is supported: with ``multi_query=False`` HF interleaves
+    q/k/v per head instead, which this flat slicing would scramble."""
+    if c.kv_heads != 1:
+        raise ValueError(
+            "gpt_bigcode converter supports multi_query=True checkpoints only "
+            f"(got kv_heads={c.kv_heads}); the non-MQA c_attn layout is per-head "
+            "interleaved and not implemented"
+        )
+    p = {
+        "embed_tokens": {"embedding": sd["transformer.wte.weight"]},
+        "embed_positions": {"embedding": sd["transformer.wpe.weight"]},
+        "ln_f": _ln(sd, "transformer.ln_f"),
+    }
+    H = c.hidden_size
+    kv_dim = c.kv_heads * c.dim_per_head
+    for i in range(c.num_layers):
+        pre = f"transformer.h.{i}"
+        cw = sd[f"{pre}.attn.c_attn.weight"]  # [H + 2*kv_dim, H] (nn.Linear layout)
+        cb = sd[f"{pre}.attn.c_attn.bias"]
+        p[f"layers_{i}"] = {
+            "ln_1": _ln(sd, f"{pre}.ln_1"),
+            "ln_2": _ln(sd, f"{pre}.ln_2"),
+            "attn": {
+                "q_proj": {"kernel": cw[:H].T, "bias": cb[:H]},
+                "k_proj": {"kernel": cw[H : H + kv_dim].T, "bias": cb[H : H + kv_dim]},
+                "v_proj": {"kernel": cw[H + kv_dim :].T, "bias": cb[H + kv_dim :]},
+                "o_proj": _linear(sd, f"{pre}.attn.c_proj"),
+            },
+            "mlp": {
+                "up_proj": _linear(sd, f"{pre}.mlp.c_fc"),
+                "down_proj": _linear(sd, f"{pre}.mlp.c_proj"),
+            },
+        }
+    return p
+
+
+def _bigcode_from_params(p, c):
+    if c.kv_heads != 1:
+        raise ValueError("gpt_bigcode export supports multi_query=True configs only")
+    sd = {
+        "transformer.wte.weight": p["embed_tokens"]["embedding"],
+        "transformer.wpe.weight": p["embed_positions"]["embedding"],
+        "transformer.ln_f.weight": p["ln_f"]["scale"],
+        "transformer.ln_f.bias": p["ln_f"]["bias"],
+    }
+    for i in range(c.num_layers):
+        L = p[f"layers_{i}"]
+        pre = f"transformer.h.{i}"
+        sd[f"{pre}.ln_1.weight"] = L["ln_1"]["scale"]
+        sd[f"{pre}.ln_1.bias"] = L["ln_1"]["bias"]
+        sd[f"{pre}.ln_2.weight"] = L["ln_2"]["scale"]
+        sd[f"{pre}.ln_2.bias"] = L["ln_2"]["bias"]
+        sd[f"{pre}.attn.c_attn.weight"] = np.concatenate(
+            [L["attn"][k]["kernel"].T for k in ("q_proj", "k_proj", "v_proj")], axis=0
+        )
+        sd[f"{pre}.attn.c_attn.bias"] = np.concatenate(
+            [L["attn"][k]["bias"] for k in ("q_proj", "k_proj", "v_proj")]
+        )
+        sd[f"{pre}.attn.c_proj.weight"] = L["attn"]["o_proj"]["kernel"].T
+        sd[f"{pre}.attn.c_proj.bias"] = L["attn"]["o_proj"]["bias"]
+        sd[f"{pre}.mlp.c_fc.weight"] = L["mlp"]["up_proj"]["kernel"].T
+        sd[f"{pre}.mlp.c_fc.bias"] = L["mlp"]["up_proj"]["bias"]
+        sd[f"{pre}.mlp.c_proj.weight"] = L["mlp"]["down_proj"]["kernel"].T
+        sd[f"{pre}.mlp.c_proj.bias"] = L["mlp"]["down_proj"]["bias"]
+    return sd
+
+
 CONVERTERS = {
     "gpt2": (_gpt2_to_params, _gpt2_from_params),
     "llama": (_llama_to_params, _llama_from_params),
     "gpt_neox": (_neox_to_params, _neox_from_params),
     "gptj": (_gptj_to_params, _gptj_from_params),
     "opt": (_opt_to_params, _opt_from_params),
+    "bloom": (_bloom_to_params, _bloom_from_params),
+    "gpt_bigcode": (_bigcode_to_params, _bigcode_from_params),
 }
 # "t5" is registered below once its converters are defined (seq2seq section)
 
@@ -414,11 +549,13 @@ def load_pretrained(
 
 def _family_of(name: str) -> str:
     key = name.lower().replace("-", "").replace("_", "")
-    for family in ("gptneox", "gptj", "gpt2", "llama", "opt"):
+    for family in ("gptbigcode", "gptneox", "gptj", "gpt2", "llama", "opt", "bloom"):
         if family in key:
-            return {"gptneox": "gpt_neox"}.get(family, family)
+            return {"gptneox": "gpt_neox", "gptbigcode": "gpt_bigcode"}.get(family, family)
     if "pythia" in key or "neox" in key:
         return "gpt_neox"
+    if "starcoder" in key or "santacoder" in key:
+        return "gpt_bigcode"
     return "gpt2"
 
 
@@ -480,6 +617,18 @@ def make_hf_config(model_type: str, c: TransformerConfig):
             num_hidden_layers=c.num_layers, num_attention_heads=c.num_heads,
             ffn_dim=c.ffn_dim, max_position_embeddings=c.max_position_embeddings,
             do_layer_norm_before=True,
+        )
+    if model_type == "bloom":
+        return transformers.BloomConfig(
+            vocab_size=c.vocab_size, hidden_size=c.hidden_size, n_layer=c.num_layers,
+            n_head=c.num_heads, layer_norm_epsilon=c.norm_eps,
+        )
+    if model_type == "gpt_bigcode":
+        return transformers.GPTBigCodeConfig(
+            vocab_size=c.vocab_size, n_embd=c.hidden_size, n_layer=c.num_layers,
+            n_head=c.num_heads, n_positions=c.max_position_embeddings,
+            n_inner=c.ffn_dim, layer_norm_epsilon=c.norm_eps,
+            multi_query=c.kv_heads == 1, activation_function="gelu_pytorch_tanh",
         )
     if model_type == "t5":
         return transformers.T5Config(

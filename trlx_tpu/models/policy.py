@@ -48,10 +48,10 @@ class CausalLMWithValueHead(nn.Module):
             self.value_ln = _norm_module(self.config)
 
     def _value_branch(self, hidden, attention_mask, positions):
-        from trlx_tpu.models.transformer import make_causal_bias
+        from trlx_tpu.models.transformer import make_attn_bias
 
         B, T, _ = hidden.shape
-        default_positions, mask_bias = make_causal_bias(attention_mask, B, T)
+        default_positions, mask_bias = make_attn_bias(self.config, attention_mask, B, T)
         if positions is None:
             positions = default_positions
         x = hidden

@@ -42,6 +42,22 @@ PRESETS: Dict[str, TransformerConfig] = {
         rope_style="neox", norm="rmsnorm", norm_eps=1e-6, activation="silu", glu=True,
         attn_bias=False, mlp_bias=False, tie_word_embeddings=False,
     ),
+    # reference parity: BloomModelBranch (modeling_ppo.py:816) — ALiBi positions,
+    # embedding LayerNorm, fused per-head qkv, tied embeddings
+    "bloom": TransformerConfig(
+        vocab_size=250880, hidden_size=1024, num_layers=24, num_heads=16,
+        max_position_embeddings=2048, pos_embedding="alibi", norm="layernorm",
+        activation="gelu_new", attn_bias=True, mlp_bias=True, embed_ln=True,
+        tie_word_embeddings=True,
+    ),
+    # reference parity: GPTBigCodeModelBranch (modeling_ppo.py:1079) — multi-query
+    # attention (1 kv head), learned positions, tanh-gelu
+    "gpt_bigcode": TransformerConfig(
+        vocab_size=49152, hidden_size=2048, num_layers=24, num_heads=16,
+        num_kv_heads=1, max_position_embeddings=2048, pos_embedding="learned",
+        norm="layernorm", activation="gelu_new", attn_bias=True, mlp_bias=True,
+        tie_word_embeddings=True,
+    ),
 }
 
 
@@ -52,12 +68,14 @@ def get_preset(name: str, overrides: Optional[Dict[str, Any]] = None) -> Transfo
     if key in PRESETS:
         config = PRESETS[key]
     else:
-        for family in ("gpt_neox", "gptj", "gpt2", "llama", "opt"):
+        for family in ("gpt_bigcode", "gpt_neox", "gptj", "gpt2", "llama", "opt", "bloom"):
             if family.replace("_", "") in key.replace("_", "").replace("-", ""):
                 config = PRESETS[family]
                 break
         if config is None and ("pythia" in key or "neox" in key):
             config = PRESETS["gpt_neox"]
+        if config is None and ("starcoder" in key or "santacoder" in key):
+            config = PRESETS["gpt_bigcode"]
     if config is None:
         raise ValueError(f"Unknown architecture preset for {name!r}; known: {sorted(PRESETS)}")
     if overrides:
@@ -112,6 +130,22 @@ def from_hf_config(hf_config, overrides: Optional[Dict[str, Any]] = None) -> Tra
             rope_theta=getattr(hf_config, "rope_theta", 10000.0),
             norm_eps=hf_config.rms_norm_eps,
             tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+        )
+    elif mt == "bloom":
+        config = PRESETS["bloom"].replace(
+            vocab_size=hf_config.vocab_size, hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.n_layer, num_heads=hf_config.n_head,
+            norm_eps=hf_config.layer_norm_epsilon,
+            tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", True),
+        )
+    elif mt == "gpt_bigcode":
+        config = PRESETS["gpt_bigcode"].replace(
+            vocab_size=hf_config.vocab_size, hidden_size=hf_config.n_embd,
+            num_layers=hf_config.n_layer, num_heads=hf_config.n_head,
+            num_kv_heads=1 if getattr(hf_config, "multi_query", True) else None,
+            intermediate_size=getattr(hf_config, "n_inner", None),
+            max_position_embeddings=hf_config.n_positions,
+            norm_eps=hf_config.layer_norm_epsilon,
         )
     else:
         raise ValueError(f"Unsupported HF model_type {mt!r}")

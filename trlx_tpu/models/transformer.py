@@ -59,7 +59,7 @@ class TransformerConfig:
     intermediate_size: Optional[int] = None  # None = 4*hidden
     max_position_embeddings: int = 1024
 
-    pos_embedding: str = "learned"  # "learned" | "rotary" | "none"
+    pos_embedding: str = "learned"  # "learned" | "rotary" | "alibi" | "none"
     rope_style: str = "neox"  # "neox" (rotate-half) | "gptj" (interleaved)
     rotary_pct: float = 1.0
     rope_theta: float = 10000.0
@@ -73,6 +73,7 @@ class TransformerConfig:
     shared_parallel_ln: bool = False  # gptj: one LN feeds both attn and mlp
     attn_bias: bool = True
     mlp_bias: bool = True
+    embed_ln: bool = False  # LayerNorm on embeddings (bloom word_embeddings_layernorm)
     head_bias: bool = False  # gptj's lm_head carries a bias
     tie_word_embeddings: bool = True
     final_norm: bool = True
@@ -137,6 +138,46 @@ def make_causal_bias(attention_mask: Optional[jnp.ndarray], B: int, T: int) -> T
     if attention_mask is not None:
         causal = jnp.logical_and(causal, attention_mask[:, None, None, :].astype(bool))
     return positions, jnp.where(causal, 0.0, -1e9).astype(jnp.float32)
+
+
+def make_attn_bias(
+    config: TransformerConfig, attention_mask: Optional[jnp.ndarray], B: int, T: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(positions, additive mask bias incl. ALiBi when configured) for a
+    cache-free forward. Use this, not make_causal_bias, wherever the config is
+    at hand — it folds the positional bias in so new call sites cannot miss it."""
+    positions, bias = make_causal_bias(attention_mask, B, T)
+    if config.pos_embedding == "alibi":
+        bias = bias + alibi_bias(config, attention_mask, B, T)
+    return positions, bias
+
+
+def alibi_slopes(num_heads: int) -> jnp.ndarray:
+    """Per-head ALiBi slopes (same algorithm as HF ``build_alibi_tensor``)."""
+    closest = 2 ** math.floor(math.log2(num_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(closest) - 3)))
+    slopes = base ** np.arange(1, closest + 1)
+    if closest != num_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * closest) - 3)))
+        num_rem = min(closest, num_heads - closest)
+        extra = extra_base ** np.arange(1, 1 + 2 * num_rem, 2)
+        slopes = np.concatenate([slopes, extra])
+    return jnp.asarray(slopes, jnp.float32)
+
+
+def alibi_bias(config: TransformerConfig, attention_mask: Optional[jnp.ndarray], B: int, S: int) -> jnp.ndarray:
+    """[B, H, 1, S] additive ALiBi bias over key slots.
+
+    Matches HF Bloom: bias = slope * key_position, where key position counts
+    valid tokens (softmax-shift-invariant vs the relative form, since the
+    -slope*q_pos term is constant per query row)."""
+    if attention_mask is not None:
+        m = attention_mask.astype(jnp.float32)
+        key_pos = (jnp.clip(jnp.cumsum(m, axis=1) - 1, 0, None) * m)
+    else:
+        key_pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.float32)[None, :], (B, S))
+    slopes = alibi_slopes(config.num_heads)
+    return slopes[None, :, None, None] * key_pos[:, None, None, :]
 
 
 def make_rotary(config: TransformerConfig, positions: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -285,6 +326,7 @@ class Attention(nn.Module):
             c.attention_impl == "flash"
             and kv_valid is not None
             and T > 1
+            and c.pos_embedding != "alibi"  # kernel takes no additive bias
             and (cache is None or _concrete_zero(cache["index"]))
         )
         if cache is not None and not use_flash:
@@ -297,7 +339,12 @@ class Attention(nn.Module):
             v = jnp.repeat(v, rep, axis=2)
 
         scale = 1.0 / math.sqrt(c.dim_per_head)
-        if c.attention_impl == "ring" and cache is None and kv_valid is not None:
+        if (
+            c.attention_impl == "ring"
+            and cache is None
+            and kv_valid is not None
+            and c.pos_embedding != "alibi"
+        ):
             from trlx_tpu.ops.ring_attention import ring_attention
 
             mesh = ambient_mesh()
@@ -381,6 +428,8 @@ class TransformerLM(nn.Module):
             c.vocab_size, c.hidden_size, dtype=c.compute_dtype, param_dtype=c.param_dtype,
             embedding_init=nn.initializers.normal(c.initializer_range),
         )
+        if c.embed_ln:
+            self.embed_layernorm = _norm_module(c)
         if c.pos_embedding == "learned":
             self.embed_positions = nn.Embed(
                 c.max_position_embeddings + c.pos_offset, c.hidden_size,
@@ -419,6 +468,8 @@ class TransformerLM(nn.Module):
         x = self.embed_tokens(input_ids)
         if self.config.pos_embedding == "learned":
             x = x + self.embed_positions(positions + self.config.pos_offset)
+        if self.config.embed_ln:
+            x = self.embed_layernorm(x)
         return x
 
     def __call__(
@@ -450,8 +501,10 @@ class TransformerLM(nn.Module):
             if attention_mask is not None:
                 causal = jnp.logical_and(causal, attention_mask[:, None, None, :].astype(bool))
             mask_bias = jnp.where(causal, 0.0, -1e9).astype(jnp.float32)
+            if c.pos_embedding == "alibi":
+                mask_bias = mask_bias + alibi_bias(c, attention_mask, B, S)
         else:
-            default_positions, mask_bias = make_causal_bias(attention_mask, B, T)
+            default_positions, mask_bias = make_attn_bias(c, attention_mask, B, T)
             if positions is None:
                 positions = default_positions
 
@@ -515,7 +568,7 @@ class TransformerLM(nn.Module):
         modeling_ppo.py:410-453) — called with the frozen param subtree via
         ``apply({"params": frozen}, ..., method="forward_from")``."""
         B, T, _ = hidden.shape
-        default_positions, mask_bias = make_causal_bias(attention_mask, B, T)
+        default_positions, mask_bias = make_attn_bias(self.config, attention_mask, B, T)
         if positions is None:
             positions = default_positions
         x = hidden
