@@ -6,7 +6,7 @@ import pytest
 
 from trlx_tpu.data.ilql_types import ILQLBatch, flatten_dataclass, unflatten_dataclass
 from trlx_tpu.data.ppo_types import PPORLElement
-from trlx_tpu.pipeline import MiniBatchIterator, PromptPipeline
+from trlx_tpu.pipeline import PromptPipeline
 from trlx_tpu.pipeline.offline_pipeline import DialogStore, tokenize_dialogue
 from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage, ppo_collate_fn
 from trlx_tpu.pipeline.tokenization import CharTokenizer
@@ -98,7 +98,7 @@ def test_ppo_collate_padding():
     assert batch.rewards[0].tolist() == [0.0, 1.0, 0.0]
 
 
-def test_ppo_storage_loader_and_minibatch():
+def test_ppo_storage_loader():
     store = PPORolloutStorage(pad_token_id=0)
     elems = [
         PPORLElement(
@@ -109,9 +109,8 @@ def test_ppo_storage_loader_and_minibatch():
     store.push(elems)
     assert len(store) == 8
     loader = store.create_loader(batch_size=4, shuffle=True)
-    mbs = next(iter(MiniBatchIterator(loader, mb_size=2, num_mb=2)))
-    assert len(mbs) == 2
-    assert mbs[0].query_tensors.shape == (2, 3)
+    batch = next(iter(loader))
+    assert batch.query_tensors.shape == (4, 3)
 
 
 def test_flatten_unflatten_dataclass():

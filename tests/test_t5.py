@@ -82,6 +82,36 @@ def test_t5_save_pretrained_roundtrip(tmp_path, t5_pair):
     np.testing.assert_allclose(np.asarray(logits1), np.asarray(logits2), atol=1e-5)
 
 
+def test_t5_hydra_branch_matches_full(t5_pair):
+    """Decoder-top hydra branch oracle: at init (trained == frozen params) the
+    branch forward must reproduce the full model's logits exactly (the seq2seq
+    analogue of the reference's forward_hydra oracle, T5Branch
+    modeling_ppo.py:1483-1593)."""
+    from trlx_tpu.models.policy import t5_branch_param_subtree
+
+    _, model, params, config = t5_pair
+    start = config.num_decoder_layers - 1
+    branch = t5_branch_param_subtree(params, start, config)
+
+    rng = np.random.default_rng(5)
+    enc_ids = jnp.asarray(rng.integers(2, 48, size=(2, 7)))
+    enc_mask = jnp.ones_like(enc_ids)
+    dec_ids = jnp.asarray(
+        np.concatenate([np.zeros((2, 1)), rng.integers(2, 48, size=(2, 4))], axis=1), jnp.int32
+    )
+    full_logits, _, _ = model.apply({"params": params}, enc_ids, enc_mask, dec_ids)
+    logits2, _, enc, branch_hidden, pos_bias = model.apply(
+        {"params": params}, enc_ids, enc_mask, dec_ids, None, start,
+        method=model.forward_with_branch,
+    )
+    np.testing.assert_allclose(np.asarray(logits2), np.asarray(full_logits), atol=1e-5)
+    ref_logits = model.apply(
+        {"params": branch}, branch_hidden, enc, enc_mask, None, pos_bias, start,
+        method=model.forward_branch,
+    )
+    np.testing.assert_allclose(np.asarray(ref_logits), np.asarray(full_logits), atol=1e-5)
+
+
 def test_t5_cached_decode_matches_full(t5_pair):
     _, model, params, config = t5_pair
     rng = np.random.default_rng(1)

@@ -192,11 +192,13 @@ def test_rft_end_to_end(tmp_path):
 
 
 @pytest.mark.slow
-def test_ppo_seq2seq_end_to_end(tmp_path):
-    """T5 PPO path (parity: reference seq2seq PPO, ppo_sentiments_t5)."""
+@pytest.mark.parametrize("n_unfrozen", [-1, 1])
+def test_ppo_seq2seq_end_to_end(tmp_path, n_unfrozen):
+    """T5 PPO path (parity: reference seq2seq PPO, ppo_sentiments_t5);
+    n_unfrozen=1 exercises the decoder-top hydra reference branch."""
     kwargs = base_kwargs(tmp_path, "PPOTrainer")
     kwargs["model"] = ModelConfig(
-        model_path="t5", model_arch_type="seq2seq", num_layers_unfrozen=-1,
+        model_path="t5", model_arch_type="seq2seq", num_layers_unfrozen=n_unfrozen,
         model_overrides=dict(
             vocab_size=len(ALPHABET) + 3, d_model=32, d_kv=8, d_ff=64,
             num_layers=2, num_decoder_layers=2, num_heads=4,

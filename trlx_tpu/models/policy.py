@@ -211,6 +211,26 @@ def apply_hydra_branch(
     )
 
 
+def t5_branch_param_subtree(t5_params: Dict[str, Any], start_layer: int, config) -> Dict[str, Any]:
+    """Frozen decoder-top branch params: decoder blocks [start_layer:], the final
+    decoder LN, and the output head (tied embedding or lm_head). The analogue of
+    :func:`branch_param_subtree` for the seq2seq hydra reference (reference
+    ``T5Branch``, modeling_ppo.py:1483-1593) — ~num_layers_unfrozen decoder
+    blocks of extra memory instead of a full frozen T5 copy."""
+    t = dict(t5_params)
+    sub: Dict[str, Any] = {}
+    for i in range(start_layer, config.num_decoder_layers):
+        key = f"decoder_blocks_{i}"
+        if key in t:
+            sub[key] = jax.tree.map(lambda x: x, t[key])
+    sub["decoder_ln"] = jax.tree.map(lambda x: x, t["decoder_ln"])
+    if config.tie_word_embeddings:
+        sub["shared"] = jax.tree.map(lambda x: x, t["shared"])
+    elif "lm_head" in t:
+        sub["lm_head"] = jax.tree.map(lambda x: x, t["lm_head"])
+    return sub
+
+
 class Seq2SeqLMWithValueHead(nn.Module):
     """T5-style seq2seq LM + scalar value head over decoder hidden states
     (parity: ``AutoModelForSeq2SeqLMWithValueHead``, modeling_ppo.py:1242-1350)."""
@@ -228,6 +248,17 @@ class Seq2SeqLMWithValueHead(nn.Module):
         logits, hidden, enc = self.t5(input_ids, attention_mask, decoder_input_ids, decoder_attention_mask)
         values = self.v_head_mlp(hidden)[..., 0]
         return logits, values, enc
+
+    def forward_with_branch(
+        self, input_ids, attention_mask, decoder_input_ids, decoder_attention_mask, branch_layer
+    ):
+        """(logits, values, enc, branch_hidden, position_bias) — the scoring
+        forward used with the decoder-top hydra reference branch."""
+        logits, hidden, enc, branch_hidden, position_bias = self.t5.forward_with_branch(
+            input_ids, attention_mask, decoder_input_ids, decoder_attention_mask, branch_layer
+        )
+        values = self.v_head_mlp(hidden)[..., 0]
+        return logits, values, enc, branch_hidden, position_bias
 
     def encode(self, input_ids, attention_mask):
         return self.t5.encode(input_ids, attention_mask)

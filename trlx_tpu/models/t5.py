@@ -274,9 +274,15 @@ class T5LM(nn.Module):
             x = block(x, mask_bias, position_bias)
         return self.encoder_ln(x)
 
-    def _decoder_stack(self, x, self_mask_bias, position_bias, enc_states, cross_mask_bias, cache, cross_kvs):
+    def _decoder_stack(
+        self, x, self_mask_bias, position_bias, enc_states, cross_mask_bias, cache, cross_kvs,
+        branch_layer=None,
+    ):
         new_caches = []
+        branch_hidden = None
         for i, block in enumerate(self.decoder_blocks):
+            if branch_layer is not None and i == branch_layer:
+                branch_hidden = x
             layer_cache = None
             if cache is not None:
                 layer_cache = {"k": cache["k"][i], "v": cache["v"][i], "index": cache["index"]}
@@ -292,7 +298,7 @@ class T5LM(nn.Module):
                 "v": jnp.stack([lc["v"] for lc in new_caches]),
                 "index": cache["index"] + x.shape[1],
             }
-        return hidden, new_cache
+        return hidden, new_cache, branch_hidden
 
     def _head(self, hidden):
         c = self.config
@@ -300,6 +306,20 @@ class T5LM(nn.Module):
             hidden = hidden * (c.d_model**-0.5)
             return hidden @ self.shared.embedding.astype(c.compute_dtype).T
         return self.lm_head(hidden)
+
+    def _self_bias_nocache(self, T, decoder_attention_mask):
+        """Cache-free causal self-attention bias [*,1,T,T]."""
+        causal = jnp.tril(jnp.ones((T, T), dtype=bool))[None, None]
+        if decoder_attention_mask is not None:
+            causal = jnp.logical_and(causal, decoder_attention_mask[:, None, None, :].astype(bool))
+        return jnp.where(causal, 0.0, -1e9).astype(jnp.float32)
+
+    def _cross_bias(self, encoder_attention_mask):
+        if encoder_attention_mask is None:
+            return None
+        return jnp.where(
+            encoder_attention_mask[:, None, None, :].astype(bool), 0.0, -1e9
+        ).astype(jnp.float32)
 
     def decode(
         self,
@@ -333,20 +353,13 @@ class T5LM(nn.Module):
             k_pos = jnp.arange(S)
             position_bias = self.decoder_blocks[0].self_attn.compute_bias(positions, k_pos)
         else:
-            causal = jnp.tril(jnp.ones((T, T), dtype=bool))[None, None]
-            if decoder_attention_mask is not None:
-                causal = jnp.logical_and(causal, decoder_attention_mask[:, None, None, :].astype(bool))
-            self_mask_bias = jnp.where(causal, 0.0, -1e9).astype(jnp.float32)
+            self_mask_bias = self._self_bias_nocache(T, decoder_attention_mask)
             pos = jnp.arange(T)
             position_bias = self.decoder_blocks[0].self_attn.compute_bias(pos, pos)
 
-        cross_mask_bias = None
-        if encoder_attention_mask is not None:
-            cross_mask_bias = jnp.where(
-                encoder_attention_mask[:, None, None, :].astype(bool), 0.0, -1e9
-            ).astype(jnp.float32)
+        cross_mask_bias = self._cross_bias(encoder_attention_mask)
 
-        hidden, new_cache = self._decoder_stack(
+        hidden, new_cache, _ = self._decoder_stack(
             x, self_mask_bias, position_bias, enc_states, cross_mask_bias, cache, cross_kvs
         )
         return self._head(hidden), hidden, new_cache
@@ -364,6 +377,57 @@ class T5LM(nn.Module):
             decoder_input_ids, enc, attention_mask, decoder_attention_mask
         )
         return logits, hidden, enc
+
+    def forward_with_branch(
+        self,
+        input_ids: jnp.ndarray,
+        attention_mask: Optional[jnp.ndarray],
+        decoder_input_ids: jnp.ndarray,
+        decoder_attention_mask: Optional[jnp.ndarray],
+        branch_layer: int,
+    ):
+        """Full forward that also captures the hydra branch point: returns
+        (logits, decoder_hidden, encoder_states, branch_hidden, position_bias).
+        ``branch_hidden`` is the input activation of decoder block
+        ``branch_layer``; ``position_bias`` is the (frozen-by-construction)
+        relative bias the branch re-uses."""
+        enc = self.encode(input_ids, attention_mask)
+        B, T = decoder_input_ids.shape
+        x = self.shared(decoder_input_ids)
+        self_mask_bias = self._self_bias_nocache(T, decoder_attention_mask)
+        pos = jnp.arange(T)
+        position_bias = self.decoder_blocks[0].self_attn.compute_bias(pos, pos)
+        cross_mask_bias = self._cross_bias(attention_mask)
+        hidden, _, branch_hidden = self._decoder_stack(
+            x, self_mask_bias, position_bias, enc, cross_mask_bias, None, None,
+            branch_layer=branch_layer,
+        )
+        return self._head(hidden), hidden, enc, branch_hidden, position_bias
+
+    def forward_branch(
+        self,
+        branch_hidden: jnp.ndarray,
+        enc_states: jnp.ndarray,
+        encoder_attention_mask: Optional[jnp.ndarray],
+        decoder_attention_mask: Optional[jnp.ndarray],
+        position_bias: jnp.ndarray,
+        start_layer: int,
+    ):
+        """Frozen decoder-top branch: run decoder blocks [start_layer:] + final LN
+        + head from a captured branch activation (the reference's ``T5Branch``,
+        modeling_ppo.py:1483-1593 — a decoder-top reference model instead of a
+        full frozen T5 copy). Apply with the frozen param subtree from
+        :func:`trlx_tpu.models.policy.t5_branch_param_subtree`; encoder states
+        and position_bias come from the live model, whose encoder / bottom
+        decoder blocks are frozen by the train mask, so they equal the reference
+        model's."""
+        B, T, _ = branch_hidden.shape
+        self_mask_bias = self._self_bias_nocache(T, decoder_attention_mask)
+        cross_mask_bias = self._cross_bias(encoder_attention_mask)
+        x = branch_hidden
+        for block in self.decoder_blocks[start_layer:]:
+            x, _ = block(x, self_mask_bias, position_bias, enc_states, cross_mask_bias, None, None)
+        return self._head(self.decoder_ln(x))
 
     def precompute_cross_kv(self, enc_states):
         ks, vs = [], []

@@ -1,8 +1,12 @@
-"""Pipeline layer: prompt/rollout dataset abstractions, a torch-free loader, the
-gradient-accumulation minibatch slicer, and the pipeline registry.
+"""Pipeline layer: prompt/rollout dataset abstractions, a torch-free loader, and
+the pipeline registry.
 
 Parity: `/root/reference/trlx/pipeline/__init__.py:14-177` (``BasePipeline``,
-``BaseRolloutStore``, ``register_datapipeline``, ``MiniBatchIterator``). The torch
+``BaseRolloutStore``, ``register_datapipeline``). The reference's host-side
+``MiniBatchIterator`` has no counterpart here by design: gradient-accumulation
+microbatching happens inside the jitted train step as a ``lax.scan``
+(``MeshRLTrainer.make_grad_accum_step``), which keeps the full batch on device
+and the microbatch loop compiled. The torch
 ``DataLoader`` is replaced by :class:`NumpyLoader` — rollout data lives in host numpy
 and is placed onto the device mesh by the trainer (``parallel.mesh.put_batch``), so no
 framework tensor layer is needed in between.
@@ -10,12 +14,7 @@ framework tensor layer is needed in between.
 
 import random
 from abc import abstractmethod
-from dataclasses import is_dataclass
 from typing import Any, Callable, Dict, Iterable, List
-
-from trlx_tpu.utils import logging
-
-logger = logging.get_logger(__name__)
 
 from trlx_tpu.utils.registry import make_registry
 
@@ -110,51 +109,6 @@ class BaseRolloutStore:
     @abstractmethod
     def create_loader(self, batch_size: int, shuffle: bool = False) -> NumpyLoader:
         ...
-
-
-class MiniBatchIterator:
-    """Slice loader batches into gradient-accumulation microbatches
-    (parity: pipeline/__init__.py:105-177 incl. the warning semantics)."""
-
-    def __init__(self, data_loader, mb_size: int, num_mb: int):
-        self.data_loader = data_loader
-        self.data_loader_iter = iter(data_loader)
-        self.mb_size = mb_size
-        self.num_mb = num_mb
-
-    def __iter__(self):
-        return self
-
-    def __next__(self):
-        batch = next(self.data_loader_iter)
-        if batch is None:
-            logger.warning("Not enough samples to saturate the minibatch size.")
-            raise StopIteration
-
-        minibatches = []
-        for mbi in range(self.num_mb):
-            batch_dict = batch.__dict__ if is_dataclass(batch) else dict(batch)
-            sliced_data = {}
-            empty = False
-            for key, value in batch_dict.items():
-                sliced = value[mbi * self.mb_size : (mbi + 1) * self.mb_size]
-                if self.num_mb > 1 and len(sliced) == 0:
-                    logger.warning("MiniBatchIterator generated an empty minibatch.")
-                    empty = True
-                    break
-                if self.num_mb > 1 and len(sliced) < self.mb_size:
-                    logger.warning("MiniBatchIterator generated a minibatch smaller than mb_size.")
-                sliced_data[key] = sliced
-            if empty or not sliced_data:
-                break
-            if is_dataclass(batch):
-                minibatches.append(batch.__class__(**sliced_data))
-            else:
-                minibatches.append(sliced_data)
-
-        if not minibatches:
-            raise StopIteration
-        return minibatches
 
 
 from trlx_tpu.pipeline.offline_pipeline import (  # noqa: E402,F401

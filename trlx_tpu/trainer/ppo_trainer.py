@@ -28,7 +28,6 @@ from trlx_tpu.models.policy import (
 from trlx_tpu.models.transformer import TransformerLM
 from trlx_tpu.parallel import mesh as mesh_lib
 from trlx_tpu.parallel.sharding import make_param_shardings
-from trlx_tpu.pipeline import MiniBatchIterator
 from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
 from trlx_tpu.trainer import register_trainer
 from trlx_tpu.trainer.mesh_trainer import MeshRLTrainer
@@ -116,6 +115,10 @@ class PPOTrainer(MeshRLTrainer):
                 return jax.jit(lambda t: jax.tree.map(lambda x: x.copy(), t))(tree)
 
         n_unfrozen = self.config.model.num_layers_unfrozen
+        if n_unfrozen > self.model_config.num_layers:
+            raise ValueError(
+                f"num_layers_unfrozen={n_unfrozen} exceeds num_layers={self.model_config.num_layers}"
+            )
         if n_unfrozen > 0:
             self.branch_start = self.model_config.num_layers - n_unfrozen
             branch = branch_param_subtree(self.params["transformer"], self.branch_start, self.model_config)
@@ -149,15 +152,34 @@ class PPOTrainer(MeshRLTrainer):
             lambda x, s: jax.device_put(jnp.asarray(x, self.param_dtype), s), params, shardings
         )
 
-        # seq2seq reference model: full frozen copy of the T5 trunk (the reference's
-        # T5Branch decoder-top variant is a possible later optimization)
+        # seq2seq reference model: with num_layers_unfrozen > 0, a frozen copy of
+        # just the top-N decoder blocks (+ final LN + head) — the reference's
+        # T5Branch shape (modeling_ppo.py:1483-1593); otherwise a full frozen copy
         def device_copy(tree):
             with self.mesh:
                 return jax.jit(lambda t: jax.tree.map(lambda x: x.copy(), t))(tree)
 
-        self.branch_start = None
-        self.frozen_branch_params = None
-        self.ref_params = device_copy(self.params["t5"])
+        n_unfrozen = self.config.model.num_layers_unfrozen
+        if n_unfrozen > self.model_config.num_decoder_layers:
+            raise ValueError(
+                f"num_layers_unfrozen={n_unfrozen} exceeds "
+                f"num_decoder_layers={self.model_config.num_decoder_layers}"
+            )
+        if 0 < n_unfrozen < self.model_config.num_decoder_layers:
+            from trlx_tpu.models.policy import t5_branch_param_subtree
+
+            self.branch_start = self.model_config.num_decoder_layers - n_unfrozen
+            branch = t5_branch_param_subtree(self.params["t5"], self.branch_start, self.model_config)
+            self.frozen_branch_params = device_copy(branch)
+            self.ref_params = None
+        else:
+            # n_unfrozen in (-1, 0, num_decoder_layers): full frozen copy. The
+            # all-layers-unfrozen case cannot use the branch — the branch reuses
+            # the live model's decoder-block-0 relative bias, which would then
+            # be training.
+            self.branch_start = None
+            self.frozen_branch_params = None
+            self.ref_params = device_copy(self.params["t5"])
 
     def trainable_path_predicate(self, path: str) -> bool:
         if getattr(self, "is_seq2seq", False):
@@ -238,8 +260,9 @@ class PPOTrainer(MeshRLTrainer):
         if self.is_seq2seq:
             module, t5 = self.module, self._t5_module()
             start_tok = self.decoder_start_token_id
+            branch_start = self.branch_start
 
-            def score_s2s(params, ref_params, q_ids, q_mask, r_ids, r_mask):
+            def score_s2s(params, ref_params, frozen_branch, q_ids, q_mask, r_ids, r_mask):
                 Bs = q_ids.shape[0]
                 dec_in = jnp.concatenate(
                     [jnp.full((Bs, 1), start_tok, jnp.int32), r_ids[:, :-1]], axis=1
@@ -247,9 +270,19 @@ class PPOTrainer(MeshRLTrainer):
                 dec_mask = jnp.concatenate(
                     [jnp.ones((Bs, 1), jnp.int32), r_mask[:, :-1]], axis=1
                 )
-                logits, values, _ = module.apply({"params": params}, q_ids, q_mask, dec_in, dec_mask)
+                if branch_start is not None:
+                    logits, values, enc, branch_hidden, pos_bias = module.apply(
+                        {"params": params}, q_ids, q_mask, dec_in, dec_mask, branch_start,
+                        method=module.forward_with_branch,
+                    )
+                    ref_logits = t5.apply(
+                        {"params": frozen_branch}, branch_hidden, enc, q_mask, dec_mask,
+                        pos_bias, branch_start, method=t5.forward_branch,
+                    )
+                else:
+                    logits, values, _ = module.apply({"params": params}, q_ids, q_mask, dec_in, dec_mask)
+                    ref_logits, _, _ = t5.apply({"params": ref_params}, q_ids, q_mask, dec_in, dec_mask)
                 logprobs = logprobs_of_labels(logits, r_ids)
-                ref_logits, _, _ = t5.apply({"params": ref_params}, q_ids, q_mask, dec_in, dec_mask)
                 ref_logprobs = logprobs_of_labels(ref_logits, r_ids)
                 return logprobs, values.astype(jnp.float32), ref_logprobs
 
@@ -344,7 +377,7 @@ class PPOTrainer(MeshRLTrainer):
                 )
                 with self.mesh:
                     logprobs, values, ref_logprobs = score_fn(
-                        self.params, self.ref_params,
+                        self.params, self.ref_params, self.frozen_branch_params,
                         dbatch["q"], dbatch["qm"], dbatch["r"], dbatch["rm"],
                     )
             else:
