@@ -114,6 +114,41 @@ def test_evaluate_mixed_prompt_buckets(tmp_path):
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize(
+    "peft_config",
+    [
+        {"peft_type": "LORA", "r": 4},
+        {"peft_type": "PREFIX_TUNING", "num_virtual_tokens": 4},
+        {"peft_type": "PROMPT_TUNING", "num_virtual_tokens": 4},
+    ],
+)
+def test_ppo_peft_end_to_end(tmp_path, peft_config):
+    """PPO with each native peft type: adapters+heads train, the KL reference is
+    the same params with adapters structurally disabled, and the hf_model export
+    carries an adapter-only artifact (parity: reference tests/test_peft.py +
+    test_trainers.py LoRA case)."""
+    kwargs = base_kwargs(tmp_path, "PPOTrainer")
+    kwargs["model"] = ModelConfig(
+        model_path="gpt2", num_layers_unfrozen=-1,
+        model_overrides=dict(TINY_MODEL), peft_config=peft_config,
+    )
+    config = TRLConfig(
+        method=PPOConfig(
+            num_rollouts=8, chunk_size=4, ppo_epochs=1, init_kl_coef=0.01,
+            target=None, gen_kwargs=dict(max_new_tokens=6, do_sample=True, top_k=0, top_p=1.0),
+        ),
+        **kwargs,
+    )
+    trainer = trlx_tpu.train(
+        reward_fn=dog_reward, prompts=["ab", "cd ef", "gh", "a b c"] * 2,
+        eval_prompts=["ab"], config=config,
+    )
+    assert trainer.iter_count >= 3
+    hf_dir = os.path.join(config.train.checkpoint_dir, "hf_model")
+    assert os.path.exists(os.path.join(hf_dir, "adapters.msgpack"))
+
+
+@pytest.mark.slow
 def test_decode_stop_sequences(tmp_path):
     """Token-level stop trimming: outputs are cut at the first stop sequence with
     the reference's rstrip semantics, and output ids match the decoded string

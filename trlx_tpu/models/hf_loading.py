@@ -787,17 +787,67 @@ def merge_loaded_params(init_tree: Dict[str, Any], loaded_tree: Dict[str, Any]) 
     return out
 
 
+# leaf param names that belong to peft adapters (LoRA / prefix / prompt)
+ADAPTER_PARAM_NAMES = ("lora_a", "lora_b", "prefix_k", "prefix_v", "prompt_embeddings")
+
+
+def extract_adapter_params(tree: Any) -> Optional[Dict[str, Any]]:
+    """The adapter-only subtree of a params tree (None if no adapters).
+
+    Parity: the reference saves peft adapters + heads only instead of the full
+    model (modeling_base.py:347-353)."""
+    if not isinstance(tree, dict):
+        return None
+    out: Dict[str, Any] = {}
+    for k, v in tree.items():
+        if k in ADAPTER_PARAM_NAMES:
+            out[k] = v
+        elif isinstance(v, dict):
+            sub = extract_adapter_params(v)
+            if sub:
+                out[k] = sub
+    return out or None
+
+
+def save_adapters(path: str, params: Dict[str, Any]) -> bool:
+    """Write adapters.msgpack next to the export; returns False if no adapters."""
+    from flax.serialization import to_bytes
+
+    adapters = extract_adapter_params(params)
+    if not adapters:
+        return False
+    with open(os.path.join(path, "adapters.msgpack"), "wb") as f:
+        f.write(to_bytes(adapters))
+    return True
+
+
+def load_adapters(path: str, params: Dict[str, Any]) -> Dict[str, Any]:
+    """Overlay adapters.msgpack leaves onto ``params`` (shapes must match)."""
+    from flax.serialization import from_bytes
+
+    with open(os.path.join(path, "adapters.msgpack"), "rb") as f:
+        template = extract_adapter_params(params)
+        adapters = from_bytes(template, f.read())
+    return merge_loaded_params(params, adapters)
+
+
 def peft_overrides(peft_config: Optional[Dict[str, Any]]) -> Dict[str, Any]:
-    """Map a reference-style peft/LoRA config dict to TransformerConfig overrides
-    (parity: modeling_base.py:162-240; only LoRA is supported natively)."""
+    """Map a reference-style peft config dict to TransformerConfig overrides
+    (parity: modeling_base.py:162-240 — LORA, PREFIX_TUNING, PROMPT_TUNING)."""
     if not peft_config:
         return {}
     ptype = str(peft_config.get("peft_type", "LORA")).upper()
-    if ptype != "LORA":
-        raise ValueError(f"Only LoRA peft is supported natively (got {ptype!r})")
-    out = {"lora_r": int(peft_config.get("r", 8)),
-           "lora_alpha": float(peft_config.get("lora_alpha", peft_config.get("alpha", 16)))}
-    targets = peft_config.get("target_modules")
-    if targets:
-        out["lora_targets"] = tuple(targets)
-    return out
+    if ptype == "LORA":
+        out = {"lora_r": int(peft_config.get("r", 8)),
+               "lora_alpha": float(peft_config.get("lora_alpha", peft_config.get("alpha", 16)))}
+        targets = peft_config.get("target_modules")
+        if targets:
+            out["lora_targets"] = tuple(targets)
+        return out
+    if ptype in ("PREFIX_TUNING", "PREFIX"):
+        return {"peft_type": "prefix",
+                "num_virtual_tokens": int(peft_config.get("num_virtual_tokens", 8))}
+    if ptype in ("PROMPT_TUNING", "PROMPT"):
+        return {"peft_type": "prompt",
+                "num_virtual_tokens": int(peft_config.get("num_virtual_tokens", 8))}
+    raise ValueError(f"Unsupported peft_type {ptype!r} (LORA / PREFIX_TUNING / PROMPT_TUNING)")

@@ -88,11 +88,16 @@ class TransformerConfig:
     # modeling_nemo_ppo.py:160-164). Applied on cache-free forwards.
     sequence_sharding: bool = False
 
-    # LoRA adapters (native peft equivalent; reference uses the peft library —
-    # modeling_base.py:162-240). r=0 disables.
+    # Native peft equivalents (reference uses the peft library —
+    # modeling_base.py:162-240). LoRA: r=0 disables. peft_type "prefix" adds
+    # per-layer learned K/V prefixes; "prompt" prepends learned virtual-token
+    # embeddings. A module built with peft_type="none"/lora_r=0 simply ignores
+    # adapter params present in the tree — that IS the disable_adapter path.
     lora_r: int = 0
     lora_alpha: float = 16.0
     lora_targets: Tuple[str, ...] = ("q_proj", "v_proj")
+    peft_type: str = "none"  # "none" | "prefix" | "prompt" (lora via lora_r)
+    num_virtual_tokens: int = 0
 
     @property
     def kv_heads(self) -> int:
@@ -327,10 +332,31 @@ class Attention(nn.Module):
             and kv_valid is not None
             and T > 1
             and c.pos_embedding != "alibi"  # kernel takes no additive bias
+            and c.peft_type != "prefix"  # prefix keys break the kernel's causal index math
             and (cache is None or _concrete_zero(cache["index"]))
         )
         if cache is not None and not use_flash:
             k, v = ck, cv  # attend over the cache (decode step / XLA prefill)
+
+        # prefix tuning: learned per-layer K/V prepended to whatever we attend
+        # over (never cached — they are static), visible to every query (zero
+        # bias). No positions are consumed and no rotary is applied to them
+        # (parity: peft PREFIX_TUNING past_key_values, modeling_base.py:162-240).
+        if c.peft_type == "prefix" and c.num_virtual_tokens > 0:
+            nv = c.num_virtual_tokens
+            pk = self.param(
+                "prefix_k", nn.initializers.normal(c.initializer_range),
+                (nv, c.kv_heads, c.dim_per_head), c.param_dtype,
+            )
+            pv = self.param(
+                "prefix_v", nn.initializers.normal(c.initializer_range),
+                (nv, c.kv_heads, c.dim_per_head), c.param_dtype,
+            )
+            k = jnp.concatenate([jnp.broadcast_to(pk.astype(k.dtype)[None], (B,) + pk.shape), k], axis=1)
+            v = jnp.concatenate([jnp.broadcast_to(pv.astype(v.dtype)[None], (B,) + pv.shape), v], axis=1)
+            mask_bias = jnp.concatenate(
+                [jnp.zeros(mask_bias.shape[:-1] + (nv,), mask_bias.dtype), mask_bias], axis=-1
+            )
 
         # grouped-query: repeat kv heads
         if c.kv_heads != c.num_heads:
@@ -344,6 +370,7 @@ class Attention(nn.Module):
             and cache is None
             and kv_valid is not None
             and c.pos_embedding != "alibi"
+            and c.peft_type != "prefix"
         ):
             from trlx_tpu.ops.ring_attention import ring_attention
 
@@ -430,6 +457,13 @@ class TransformerLM(nn.Module):
         )
         if c.embed_ln:
             self.embed_layernorm = _norm_module(c)
+        if c.peft_type == "prompt" and c.num_virtual_tokens > 0:
+            # prompt tuning: learned virtual-token embeddings prepended to the
+            # input (parity: peft PROMPT_TUNING, modeling_base.py:162-240)
+            self.prompt_embeddings = self.param(
+                "prompt_embeddings", nn.initializers.normal(c.initializer_range),
+                (c.num_virtual_tokens, c.hidden_size), c.param_dtype,
+            )
         if c.pos_embedding == "learned":
             self.embed_positions = nn.Embed(
                 c.max_position_embeddings + c.pos_offset, c.hidden_size,
@@ -487,36 +521,85 @@ class TransformerLM(nn.Module):
         its input activation is returned for the hydra reference branch."""
         c = self.config
         B, T = input_ids.shape
+        nv = c.num_virtual_tokens if c.peft_type == "prompt" else 0
+        # prompt tuning prepends nv virtual rows internally; the external
+        # contract (T-length outputs, T/S-length masks) is preserved by
+        # extending masks here and slicing logits/hidden before returning.
+        # Virtual rows occupy slots/positions 0..nv-1; real positions shift +nv.
+        nv_rows = 0  # virtual rows present in this forward's activations
         if cache is not None:
-            S = cache["k"].shape[2]  # [L,B,S,H,D] -> S at axis 2
+            S = cache["k"].shape[2]  # [L,B,S,H,D] -> S at axis 2 (incl. nv slots)
             idx = cache["index"]
+            # a concrete-zero index marks prefill-from-zero (any T, including 1);
+            # a traced index is a decode step inside the generation while_loop
+            prompt_prefill = nv > 0 and _concrete_zero(idx)
+            if nv > 0 and not (prompt_prefill or T == 1):
+                raise ValueError(
+                    "prompt-tuning cached forwards support only prefill-from-zero "
+                    "or single-token decode steps"
+                )
+            ext_mask = attention_mask
+            if nv and attention_mask is not None:
+                ext_mask = jnp.concatenate(
+                    [jnp.ones((B, nv), attention_mask.dtype), attention_mask], axis=1
+                )
             if positions is None:
-                positions = idx + jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+                # auto-derived decode positions come from the cache index, which
+                # already counts the nv virtual slots — shift only at prefill
+                base = idx + jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+                int_positions = base + nv if prompt_prefill else base
+            else:
+                int_positions = positions + nv if nv else positions
+            nv_rows = nv if prompt_prefill else 0
+            T_eff = T + nv_rows
             # Causal structure over cache *slots*: slots are written in temporal
             # order, so slot index ordering == temporal ordering even with left
             # padding (where position values repeat under the pad mask).
             kv_slot = jnp.arange(S)[None, None, None, :]
-            q_slot = (idx + jnp.arange(T, dtype=jnp.int32))[None, None, :, None]
+            q_slot = (idx + jnp.arange(T_eff, dtype=jnp.int32))[None, None, :, None]
             causal = kv_slot <= q_slot
-            if attention_mask is not None:
-                causal = jnp.logical_and(causal, attention_mask[:, None, None, :].astype(bool))
+            if ext_mask is not None:
+                causal = jnp.logical_and(causal, ext_mask[:, None, None, :].astype(bool))
             mask_bias = jnp.where(causal, 0.0, -1e9).astype(jnp.float32)
             if c.pos_embedding == "alibi":
-                mask_bias = mask_bias + alibi_bias(c, attention_mask, B, S)
+                mask_bias = mask_bias + alibi_bias(c, ext_mask, B, S)
+            x = self.embed(input_ids, int_positions)
+            layer_positions = int_positions
+            if nv_rows:
+                virt_pos = jnp.broadcast_to(jnp.arange(nv, dtype=jnp.int32)[None, :], (B, nv))
+                layer_positions = jnp.concatenate([virt_pos, int_positions], axis=1)
+                pe = jnp.broadcast_to(
+                    self.prompt_embeddings.astype(x.dtype)[None], (B, nv, c.hidden_size)
+                )
+                x = jnp.concatenate([pe, x], axis=1)
+            if T_eff > 1 and ext_mask is not None:
+                # generation prefill: the cache is written from slot 0, so the
+                # flash path may attend over the prefix k/v alone
+                kv_valid = ext_mask[:, :T_eff]
+            else:
+                kv_valid = None
         else:
-            default_positions, mask_bias = make_attn_bias(c, attention_mask, B, T)
-            if positions is None:
-                positions = default_positions
-
-        x = self.embed(input_ids, positions)
-        if cache is None:
-            kv_valid = attention_mask
-        elif T > 1 and attention_mask is not None:
-            # generation prefill: the cache is written from slot 0, so the flash
-            # path may attend over the prefix k/v alone (mask = prompt slots)
-            kv_valid = attention_mask[:, :T]
-        else:
-            kv_valid = None
+            mask_in = attention_mask
+            if nv:
+                nv_rows = nv
+                if mask_in is None:
+                    mask_in = jnp.ones((B, T), jnp.int32)
+                ext_mask = jnp.concatenate([jnp.ones((B, nv), mask_in.dtype), mask_in], axis=1)
+                default_positions, mask_bias = make_attn_bias(c, ext_mask, B, T + nv)
+                int_positions = default_positions[:, nv:] if positions is None else positions + nv
+                layer_positions = jnp.concatenate([default_positions[:, :nv], int_positions], axis=1)
+                pe = jnp.broadcast_to(
+                    self.prompt_embeddings.astype(c.compute_dtype)[None], (B, nv, c.hidden_size)
+                )
+                x = jnp.concatenate([pe, self.embed(input_ids, int_positions)], axis=1)
+                kv_valid = ext_mask
+            else:
+                default_positions, mask_bias = make_attn_bias(c, attention_mask, B, T)
+                if positions is None:
+                    positions = default_positions
+                x = self.embed(input_ids, positions)
+                layer_positions = positions
+                kv_valid = attention_mask
         # branch_layer: int -> return that single activation; tuple -> dict of them
         capture_set = ()
         if branch_layer is not None:
@@ -533,7 +616,7 @@ class TransformerLM(nn.Module):
             layer_cache = None
             if cache is not None:
                 layer_cache = {"k": cache["k"][i], "v": cache["v"][i], "index": cache["index"]}
-            x, new_lc = layer(x, mask_bias, positions, layer_cache, kv_valid)
+            x, new_lc = layer(x, mask_bias, layer_positions, layer_cache, kv_valid)
             if seq_shard:
                 x = constrain_seq(x)
             if cache is not None:
@@ -543,12 +626,15 @@ class TransformerLM(nn.Module):
             # gather_from_sequence_parallel_region analogue)
             x = constrain_gathered(x)
         logits, hidden = self._final(x)
+        if nv_rows:  # drop virtual rows: external output shape is [B, T, ...]
+            logits = logits[:, nv_rows:]
+            hidden = hidden[:, nv_rows:]
         new_cache = None
         if cache is not None:
             new_cache = {
                 "k": jnp.stack([lc["k"] for lc in new_layer_caches]),
                 "v": jnp.stack([lc["v"] for lc in new_layer_caches]),
-                "index": cache["index"] + T,
+                "index": cache["index"] + T + nv_rows,
             }
         if branch_layer is not None and not isinstance(branch_layer, tuple):
             branch_out = captures.get(branch_layer)
@@ -582,6 +668,8 @@ class TransformerLM(nn.Module):
     def init_cache(self, batch_size: int, max_length: int, dtype=None) -> KVCache:
         c = self.config
         dtype = dtype or c.compute_dtype
+        if c.peft_type == "prompt":
+            max_length += c.num_virtual_tokens  # virtual rows live in the cache too
         shape = (c.num_layers, batch_size, max_length, c.kv_heads, c.dim_per_head)
         return {
             "k": jnp.zeros(shape, dtype),

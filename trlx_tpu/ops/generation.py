@@ -76,6 +76,12 @@ def generate(
     prompt_lens = attention_mask.sum(axis=1).astype(jnp.int32)
 
     cache = init_cache_fn(B, total)
+    if isinstance(cache, dict) and "index" in cache:
+        # static Python 0: marks prefill-from-zero at TRACE time, so the model's
+        # prefill-only paths (flash kernel, prompt-tuning prepend) engage even
+        # when this whole function is wrapped in an outer jit (where a
+        # jnp.array(0) constant would already be a tracer)
+        cache = {**cache, "index": 0}
     # mask over all cache slots; generated slots get enabled as they are written
     full_mask = jnp.concatenate([attention_mask.astype(jnp.int32), jnp.zeros((B, N), jnp.int32)], axis=1)
 

@@ -96,8 +96,11 @@ class MeshRLTrainer(BaseRLTrainer):
         reference utils/modeling.py:22-45): with num_layers_unfrozen = N > 0, only
         the top N transformer layers and all heads train; -1 trains everything."""
         if self.config.model.peft_config:
-            # LoRA mode: only adapters and heads receive gradients
-            return "lora_" in path or ("transformer" not in path and "t5" not in path)
+            # peft mode: only adapters (LoRA / prefix K-V / prompt embeddings)
+            # and heads receive gradients
+            if any(a in path for a in ("lora_", "prefix_k", "prefix_v", "prompt_embeddings")):
+                return True
+            return "transformer" not in path and "t5" not in path
         n_unfrozen = self.config.model.num_layers_unfrozen
         if n_unfrozen < 0:
             return True
@@ -532,6 +535,10 @@ class MeshRLTrainer(BaseRLTrainer):
             if heads:
                 with open(os.path.join(directory, "heads.msgpack"), "wb") as f:
                     f.write(to_bytes(heads))
+            if self.config.model.peft_config:
+                from trlx_tpu.models.hf_loading import save_adapters
+
+                save_adapters(directory, params)
 
 
 def _opt_step_count(opt_state) -> jnp.ndarray:

@@ -119,7 +119,20 @@ class PPOTrainer(MeshRLTrainer):
             raise ValueError(
                 f"num_layers_unfrozen={n_unfrozen} exceeds num_layers={self.model_config.num_layers}"
             )
-        if n_unfrozen > 0:
+        self.peft_base_ref = bool(self.config.model.peft_config)
+        if self.peft_base_ref:
+            # peft mode: the trunk is frozen and only adapters train, so the KL
+            # reference is the SAME params applied through a module with the
+            # adapters structurally disabled (flax ignores the extra adapter
+            # entries) — the reference's disable_adapter() forward_hydra path
+            # (modeling_ppo.py:410-453) with zero extra memory.
+            self.base_trunk_module = TransformerLM(
+                self.model_config.replace(lora_r=0, peft_type="none", num_virtual_tokens=0)
+            )
+            self.branch_start = None
+            self.frozen_branch_params = None
+            self.ref_params = None
+        elif n_unfrozen > 0:
             self.branch_start = self.model_config.num_layers - n_unfrozen
             branch = branch_param_subtree(self.params["transformer"], self.branch_start, self.model_config)
             self.frozen_branch_params = device_copy(branch)
@@ -133,10 +146,17 @@ class PPOTrainer(MeshRLTrainer):
         from trlx_tpu.models.hf_loading import load_pretrained_seq2seq
         from trlx_tpu.models.policy import Seq2SeqLMWithValueHead
 
+        if self.config.model.peft_config:
+            raise NotImplementedError(
+                "peft adapters are not implemented for the seq2seq (T5) path; "
+                "use num_layers_unfrozen for parameter-efficient seq2seq training"
+            )
+
         self.model_config, t5_params = load_pretrained_seq2seq(
             self.config.model.model_path, overrides
         )
         self.model_type = "t5"
+        self.peft_base_ref = False
         self.decoder_start_token_id = self.model_config.decoder_start_token_id
         self.module = Seq2SeqLMWithValueHead(self.model_config)
         params = self.module.init(
@@ -291,13 +311,20 @@ class PPOTrainer(MeshRLTrainer):
 
         module, trunk = self.module, self.trunk_module
         branch_start = self.branch_start
+        peft_base_ref = self.peft_base_ref
+        base_trunk = getattr(self, "base_trunk_module", None)
 
         def score(params, ref_params, frozen_branch, seq, mask):
             logits, values, branch_hidden, _ = module.apply(
                 {"params": params}, seq, mask, branch_layer=branch_start
             )
             logprobs = logprobs_of_labels(logits[:, :-1], seq[:, 1:])
-            if branch_start is not None:
+            if peft_base_ref:
+                # same (frozen) trunk params, adapters structurally disabled
+                ref_logits, _, _, _ = base_trunk.apply(
+                    {"params": params["transformer"]}, seq, mask
+                )
+            elif branch_start is not None:
                 ref_logits = module.apply(
                     {"params": {"transformer": frozen_branch}},
                     branch_hidden, mask, None, branch_start,
