@@ -63,11 +63,30 @@ def main(hparams={}):
     config = TRLConfig.update(build_config().to_dict(), hparams)
     chosen_by_prompt = dict(zip(PROMPTS, CHOSEN))
 
-    def reward_fn(samples: List[str], prompts: List[str], outputs: List[str], **kw):
-        # reward model stand-in; delta vs the dataset's chosen response
-        scores = lexicon_sentiment(outputs)
-        chosen_scores = lexicon_sentiment([chosen_by_prompt.get(p, "") for p in prompts])
-        return [s - c for s, c in zip(scores, chosen_scores)]
+    reward_url = os.environ.get("TRLX_REWARD_URL")
+    if reward_url:
+        # served reward model over HTTP (parity: the reference's Triton-served
+        # reward on a dedicated GPU, ppo_hh.py:119-139). Start the server with
+        # `python examples/hh/serve_reward.py`. Generation overlaps with the
+        # remote scoring round-trip (method.overlap_reward_scoring).
+        from examples.hh.reward_client import RemoteRewardClient
+
+        client = RemoteRewardClient(reward_url)
+        config.method.overlap_reward_scoring = True
+
+        def reward_fn(samples: List[str], prompts: List[str], outputs: List[str], **kw):
+            return client(
+                samples, prompts=prompts, outputs=outputs,
+                chosen=[chosen_by_prompt.get(p, "") for p in prompts],
+            )
+
+    else:
+
+        def reward_fn(samples: List[str], prompts: List[str], outputs: List[str], **kw):
+            # reward model stand-in; delta vs the dataset's chosen response
+            scores = lexicon_sentiment(outputs)
+            chosen_scores = lexicon_sentiment([chosen_by_prompt.get(p, "") for p in prompts])
+            return [s - c for s, c in zip(scores, chosen_scores)]
 
     trlx_tpu.train(
         reward_fn=reward_fn,

@@ -1,0 +1,44 @@
+"""HTTP reward-model client (parity: the Triton client in the reference's
+`examples/hh/ppo_hh.py:119-139`). Speaks the Triton HTTP/REST inference shape,
+so it works against `serve_reward.py` locally or a real Triton endpoint."""
+
+import json
+import urllib.request
+from typing import List, Optional
+
+
+class RemoteRewardClient:
+    """POSTs (samples, prompts, outputs, chosen) as named BYTES tensors and
+    returns the FP32 "rewards" output tensor."""
+
+    def __init__(self, url: str, timeout: float = 60.0):
+        self.url = url
+        self.timeout = timeout
+
+    def __call__(
+        self,
+        samples: List[str],
+        prompts: Optional[List[str]] = None,
+        outputs: Optional[List[str]] = None,
+        chosen: Optional[List[str]] = None,
+        **_,
+    ) -> List[float]:
+        inputs = [
+            {"name": "samples", "datatype": "BYTES", "shape": [len(samples)], "data": list(samples)}
+        ]
+        for name, data in (("prompts", prompts), ("outputs", outputs), ("chosen", chosen)):
+            if data is not None:
+                inputs.append(
+                    {"name": name, "datatype": "BYTES", "shape": [len(data)], "data": list(data)}
+                )
+        req = urllib.request.Request(
+            self.url,
+            data=json.dumps({"inputs": inputs}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            payload = json.loads(resp.read())
+        for tensor in payload.get("outputs", []):
+            if tensor["name"] == "rewards":
+                return [float(x) for x in tensor["data"]]
+        raise RuntimeError(f"no 'rewards' tensor in response: {payload}")

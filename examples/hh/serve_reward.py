@@ -1,0 +1,73 @@
+"""Served reward model — the RPC-reward path of the reference's HH recipe.
+
+The reference serves its 6B reward model through NVIDIA Triton on a dedicated GPU
+and scores rollouts over HTTP (`/root/reference/examples/hh/ppo_hh.py:119-139`,
+`to_triton.py`). This is the trlx_tpu counterpart: a stdlib HTTP server exposing
+the same request shape Triton's HTTP/REST inference API uses
+(`POST /v2/models/<name>/infer` with named tensors), so a real Triton deployment
+is a drop-in replacement for this process. In the zero-egress sandbox the model
+behind it is the lexicon stand-in; behind a real endpoint it would be the trained
+reward checkpoint.
+
+Run:  python examples/hh/serve_reward.py [--port 8500]
+Then: TRLX_REWARD_URL=http://localhost:8500/v2/models/reward/infer \
+      python examples/hh/ppo_hh.py
+"""
+
+import argparse
+import json
+import sys
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+sys.path.insert(0, ".")
+
+from examples.sentiment_task import lexicon_sentiment  # noqa: E402
+
+
+class RewardHandler(BaseHTTPRequestHandler):
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            req = json.loads(self.rfile.read(length))
+            # Triton HTTP shape: {"inputs": [{"name": ..., "datatype": "BYTES",
+            #   "shape": [N], "data": [...strings...]}, ...]}
+            tensors = {t["name"]: t["data"] for t in req.get("inputs", [])}
+            outputs = tensors.get("outputs") or tensors.get("samples") or []
+            scores = lexicon_sentiment([str(s) for s in outputs])
+            chosen = tensors.get("chosen")
+            if chosen:
+                chosen_scores = lexicon_sentiment([str(s) for s in chosen])
+                scores = [s - c for s, c in zip(scores, chosen_scores)]
+            body = json.dumps(
+                {
+                    "model_name": "reward",
+                    "outputs": [
+                        {"name": "rewards", "datatype": "FP32",
+                         "shape": [len(scores)], "data": [float(s) for s in scores]}
+                    ],
+                }
+            ).encode()
+            self.send_response(200)
+        except Exception as e:  # malformed request
+            body = json.dumps({"error": str(e)}).encode()
+            self.send_response(400)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=8500)
+    args = parser.parse_args()
+    server = HTTPServer(("127.0.0.1", args.port), RewardHandler)
+    print(f"reward server listening on http://127.0.0.1:{args.port}/v2/models/reward/infer", flush=True)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -149,6 +149,35 @@ def test_ppo_peft_end_to_end(tmp_path, peft_config):
 
 
 @pytest.mark.slow
+def test_ppo_overlap_reward_scoring(tmp_path):
+    """Double-buffered rollouts: reward_fn for chunk i runs on a worker thread
+    while chunk i+1 generates; results must be complete and ordered."""
+    calls = []
+
+    def slow_reward(samples, **kw):
+        calls.append(len(samples))
+        import time
+
+        time.sleep(0.05)
+        return [float(s.count("a")) for s in samples]
+
+    config = TRLConfig(
+        method=PPOConfig(
+            num_rollouts=8, chunk_size=4, ppo_epochs=1, init_kl_coef=0.01,
+            target=None, overlap_reward_scoring=True,
+            gen_kwargs=dict(max_new_tokens=6, do_sample=True, top_k=0, top_p=1.0),
+        ),
+        **base_kwargs(tmp_path, "PPOTrainer"),
+    )
+    trainer = trlx_tpu.train(
+        reward_fn=slow_reward, prompts=["ab", "cd ef", "gh", "a b c"] * 2,
+        eval_prompts=["ab"], config=config,
+    )
+    assert trainer.iter_count >= 3
+    assert len(trainer.store) >= 8  # full experience despite async scoring
+
+
+@pytest.mark.slow
 def test_decode_stop_sequences(tmp_path):
     """Token-level stop trimming: outputs are cut at the first stop sequence with
     the reference's rstrip semantics, and output ids match the decoded string

@@ -121,6 +121,11 @@ class PPOConfig(MethodConfig):
     gen_kwargs: Dict[str, Any] = field(default_factory=lambda: dict(max_new_tokens=16))
     gen_experience_kwargs: Optional[Dict[str, Any]] = None
     num_value_layers_unfrozen: int = 0
+    # overlap reward_fn scoring of chunk i with generation of chunk i+1 during
+    # make_experience (double-buffer; worthwhile when the reward model is served
+    # remotely — the RPC round-trip hides behind device work). reward_fn then
+    # runs on a worker thread, so it must be thread-safe.
+    overlap_reward_scoring: bool = False
 
     def kl_controller(self):
         if self.target is not None:

@@ -451,6 +451,12 @@ class MeshRLTrainer(BaseRLTrainer):
                         if results["reward/mean"] > self.best_reward:
                             self.best_reward = results["reward/mean"]
                             self.save(os.path.join(train_config.checkpoint_dir, "best_checkpoint"))
+                    if self._sweep_tick(results):
+                        # ASHA early stop: exit cleanly (no signals — killing a
+                        # jax process mid-TPU-claim can wedge the chip tunnel)
+                        logger.info("Sweep scheduler requested early stop")
+                        self._report_sweep_result(results)
+                        return results
 
                 stats = {k: significant(v) if isinstance(v, float) else v for k, v in stats.items()}
                 self.tracker.log(stats, self.iter_count)
@@ -465,6 +471,26 @@ class MeshRLTrainer(BaseRLTrainer):
             self.post_epoch_callback(epoch)
         self._report_sweep_result(results)
         return results
+
+    def _sweep_tick(self, results) -> bool:
+        """Under a sweep: report intermediate metrics (consumed by the ASHA
+        scheduler in trlx_tpu/sweep.py) and poll the stop file. Returns True if
+        the scheduler asked this trial to stop."""
+        if not os.environ.get("TRLX_SWEEP"):
+            return False
+        if jax.process_index() == 0:
+            from trlx_tpu.utils import filter_non_scalars
+
+            print(
+                "SWEEP_METRIC "
+                + json.dumps({"step": self.iter_count, **filter_non_scalars(results or {})}),
+                flush=True,
+            )
+        # EVERY process polls the stop file (shared filesystem assumed), so a
+        # multi-process trial returns from learn() on all ranks together instead
+        # of deadlocking the mesh with rank 0 gone
+        stop_file = os.environ.get("TRLX_SWEEP_STOP_FILE")
+        return bool(stop_file and os.path.exists(stop_file))
 
     def _report_sweep_result(self, results):
         """Final-metrics line consumed by the sweep runner (trlx_tpu/sweep.py)."""

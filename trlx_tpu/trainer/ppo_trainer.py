@@ -345,107 +345,62 @@ class PPOTrainer(MeshRLTrainer):
 
     def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0):
         """Roll out prompts → generations → rewards → KL-penalized per-token reward
-        assembly → rollout store (parity: :251-524; see SURVEY.md §3.2)."""
+        assembly → rollout store (parity: :251-524; see SURVEY.md §3.2).
+
+        With ``method.overlap_reward_scoring``, reward_fn for chunk i runs on a
+        worker thread while chunk i+1 generates on the device — double-buffering
+        that hides a served reward model's RPC round-trip (the reference runs its
+        Triton reward scoring serially on rank 0, :303-317)."""
         logger.info(f"Collecting {num_rollouts} rollouts")
         ppo_rl_elements: List[PPORLElement] = []
         accumulated_kl = []
         all_scores_log = []
         self.clock.tick()
 
-        while len(ppo_rl_elements) < num_rollouts:
+        def generate_chunk(tokenizer):
             batch = next(self.prompt_iterator)
             prompts = batch["input_ids"]
             metadata = {k: v for k, v in batch.items() if k != "input_ids"}
-
             samples, resp_mask, pad_len = self.generate(prompts, eval_mode=False)
             str_samples, str_prompts, str_outputs, out_ids = self.decode(
                 prompts, samples, pad_len, append_eos=True, response_masks=resp_mask
             )
-
-            scores = self.reward_fn(
+            reward_kwargs = dict(
                 samples=str_samples, prompts=str_prompts, outputs=str_outputs,
-                tokenizer=self.tokenizer, **metadata,
+                tokenizer=tokenizer, **metadata,
             )
-            dense = np.ndim(scores[0]) > 0
-            if dense:
-                dense_scores = [np.asarray(s, np.float32) for s in scores]
-                scores = np.asarray([s.sum() for s in dense_scores], np.float32)
-            else:
-                dense_scores = None
-                scores = np.asarray(jax.device_get(scores), np.float32).reshape(-1)
+            return (prompts, out_ids), reward_kwargs
 
-            all_scores_log.extend(scores.tolist())
-            # clip + normalize scores (parity: :364-381)
-            scores_mean, scores_std = self.running_moments.update(scores)
-            if self.method.cliprange_reward:
-                scores = np.clip(scores, -self.method.cliprange_reward, self.method.cliprange_reward)
-            if self.method.scale_reward == "running":
-                scores = scores / max(self.running_moments.std, 1e-8)
-            elif self.method.scale_reward == "ref":
-                scores = scores / max(self.method.ref_std or 1.0, 1e-8)
+        if self.method.overlap_reward_scoring:
+            import copy
+            from concurrent.futures import ThreadPoolExecutor
 
-            # fixed-shape scoring forward
-            P = max(len(p) for p in prompts)
-            R = max(len(o) for o in out_ids)
-            from trlx_tpu.ops.generation import left_pad_batch, pad_to_bucket
-
-            P = pad_to_bucket(P, [2 ** i for i in range(3, 14)])
-            R = pad_to_bucket(R, [2 ** i for i in range(3, 14)])
-            q_ids, q_mask = left_pad_batch(prompts, self.tokenizer.pad_token_id, P)
-            r_ids = np.full((len(out_ids), R), self.tokenizer.pad_token_id, np.int32)
-            r_mask = np.zeros((len(out_ids), R), np.int32)
-            for i, o in enumerate(out_ids):
-                r_ids[i, : len(o)] = o
-                r_mask[i, : len(o)] = 1
-            score_fn = self._get_score_fn(q_ids.shape[0], P, R)
-            if self.is_seq2seq:
-                dbatch = mesh_lib.put_batch(
-                    self.mesh, {"q": q_ids, "qm": q_mask, "r": r_ids, "rm": r_mask}
-                )
-                with self.mesh:
-                    logprobs, values, ref_logprobs = score_fn(
-                        self.params, self.ref_params, self.frozen_branch_params,
-                        dbatch["q"], dbatch["qm"], dbatch["r"], dbatch["rm"],
-                    )
-            else:
-                seq = np.concatenate([q_ids, r_ids], axis=1)
-                mask = np.concatenate([q_mask, r_mask], axis=1)
-                dbatch = mesh_lib.put_batch(self.mesh, {"seq": seq, "mask": mask})
-                with self.mesh:
-                    logprobs, values, ref_logprobs = score_fn(
-                        self.params, self.ref_params, self.frozen_branch_params,
-                        dbatch["seq"], dbatch["mask"],
-                    )
-            logprobs = np.asarray(jax.device_get(logprobs))
-            values = np.asarray(jax.device_get(values))
-            ref_logprobs = np.asarray(jax.device_get(ref_logprobs))
-
-            # per-token KL penalty & reward assembly (parity: :457-492)
-            log_ratio = (logprobs - ref_logprobs) * r_mask
-            kl_per_token = np.exp(log_ratio) - 1.0 - log_ratio  # k3 estimator (:461)
-            # controller sees the per-SEQUENCE kl sum (reference :460 kl.sum(1).mean());
-            # the shipped AdaptiveKL targets (e.g. 6.0) are calibrated to that scale
-            mean_kl = kl_per_token.sum(axis=1).mean()
-            accumulated_kl.append(mean_kl)
-
-            kl_coef = self.kl_ctl.value
-            for i in range(len(prompts)):
-                l = int(r_mask[i].sum())
-                rewards = -kl_coef * log_ratio[i, :l]
-                if dense:
-                    ds = dense_scores[i]
-                    rewards[: min(l, len(ds))] += ds[: min(l, len(ds))]
-                else:
-                    rewards[l - 1] += scores[i]
-                ppo_rl_elements.append(
-                    PPORLElement(
-                        query_tensor=np.asarray(prompts[i], np.int32),
-                        response_tensor=r_ids[i, :l],
-                        logprobs=logprobs[i, :l],
-                        values=values[i, :l],
-                        rewards=rewards.astype(np.float32),
-                    )
-                )
+            # reward_fn runs on a worker thread while the main thread keeps using
+            # self.tokenizer in decode(); HF fast tokenizers are not re-entrant
+            # ("Already borrowed"), so the worker gets its own copy
+            if not hasattr(self, "_reward_tokenizer"):
+                self._reward_tokenizer = copy.deepcopy(self.tokenizer)
+            generated = 0  # count at generation time: len(ppo_rl_elements) lags
+            with ThreadPoolExecutor(max_workers=1) as pool:
+                pending = None
+                while generated < num_rollouts or pending is not None:
+                    if generated < num_rollouts:
+                        chunk, reward_kwargs = generate_chunk(self._reward_tokenizer)
+                        generated += len(chunk[0])
+                        fut = pool.submit(self.reward_fn, **reward_kwargs)
+                    else:
+                        chunk = fut = None
+                    if pending is not None:
+                        pchunk, pfut = pending
+                        self._score_and_store(
+                            pchunk, pfut.result(), ppo_rl_elements, accumulated_kl, all_scores_log
+                        )
+                    pending = (chunk, fut) if chunk is not None else None
+        else:
+            while len(ppo_rl_elements) < num_rollouts:
+                chunk, reward_kwargs = generate_chunk(self.tokenizer)
+                scores = self.reward_fn(**reward_kwargs)
+                self._score_and_store(chunk, scores, ppo_rl_elements, accumulated_kl, all_scores_log)
 
         self.mean_kl = float(np.mean(accumulated_kl))
         rollout_time = self.clock.tick()
@@ -461,6 +416,92 @@ class PPOTrainer(MeshRLTrainer):
         if self.log_rollouts:
             self.store.export_history(location=self.rollout_logging_dir, tokenizer=self.tokenizer)
         self.push_to_store(ppo_rl_elements[:num_rollouts])
+
+    def _score_and_store(self, chunk, scores, ppo_rl_elements, accumulated_kl, all_scores_log):
+        """Normalize scores, run the jitted logprob/value/ref scoring forward, and
+        assemble KL-penalized PPORLElements (parity: :364-502)."""
+        prompts, out_ids = chunk
+        dense = np.ndim(scores[0]) > 0
+        if dense:
+            dense_scores = [np.asarray(s, np.float32) for s in scores]
+            scores = np.asarray([s.sum() for s in dense_scores], np.float32)
+        else:
+            dense_scores = None
+            scores = np.asarray(jax.device_get(scores), np.float32).reshape(-1)
+
+        all_scores_log.extend(scores.tolist())
+        # clip + normalize scores (parity: :364-381)
+        scores_mean, scores_std = self.running_moments.update(scores)
+        if self.method.cliprange_reward:
+            scores = np.clip(scores, -self.method.cliprange_reward, self.method.cliprange_reward)
+        if self.method.scale_reward == "running":
+            scores = scores / max(self.running_moments.std, 1e-8)
+        elif self.method.scale_reward == "ref":
+            scores = scores / max(self.method.ref_std or 1.0, 1e-8)
+
+        # fixed-shape scoring forward
+        P = max(len(p) for p in prompts)
+        R = max(len(o) for o in out_ids)
+        from trlx_tpu.ops.generation import left_pad_batch, pad_to_bucket
+
+        P = pad_to_bucket(P, [2 ** i for i in range(3, 14)])
+        R = pad_to_bucket(R, [2 ** i for i in range(3, 14)])
+        q_ids, q_mask = left_pad_batch(prompts, self.tokenizer.pad_token_id, P)
+        r_ids = np.full((len(out_ids), R), self.tokenizer.pad_token_id, np.int32)
+        r_mask = np.zeros((len(out_ids), R), np.int32)
+        for i, o in enumerate(out_ids):
+            r_ids[i, : len(o)] = o
+            r_mask[i, : len(o)] = 1
+        score_fn = self._get_score_fn(q_ids.shape[0], P, R)
+        if self.is_seq2seq:
+            dbatch = mesh_lib.put_batch(
+                self.mesh, {"q": q_ids, "qm": q_mask, "r": r_ids, "rm": r_mask}
+            )
+            with self.mesh:
+                logprobs, values, ref_logprobs = score_fn(
+                    self.params, self.ref_params, self.frozen_branch_params,
+                    dbatch["q"], dbatch["qm"], dbatch["r"], dbatch["rm"],
+                )
+        else:
+            seq = np.concatenate([q_ids, r_ids], axis=1)
+            mask = np.concatenate([q_mask, r_mask], axis=1)
+            dbatch = mesh_lib.put_batch(self.mesh, {"seq": seq, "mask": mask})
+            with self.mesh:
+                logprobs, values, ref_logprobs = score_fn(
+                    self.params, self.ref_params, self.frozen_branch_params,
+                    dbatch["seq"], dbatch["mask"],
+                )
+        logprobs = np.asarray(jax.device_get(logprobs))
+        values = np.asarray(jax.device_get(values))
+        ref_logprobs = np.asarray(jax.device_get(ref_logprobs))
+
+        # per-token KL penalty & reward assembly (parity: :457-492)
+        log_ratio = (logprobs - ref_logprobs) * r_mask
+        kl_per_token = np.exp(log_ratio) - 1.0 - log_ratio  # k3 estimator (:461)
+        # controller sees the per-SEQUENCE kl sum (reference :460 kl.sum(1).mean());
+        # the shipped AdaptiveKL targets (e.g. 6.0) are calibrated to that scale
+        mean_kl = kl_per_token.sum(axis=1).mean()
+        accumulated_kl.append(mean_kl)
+
+        kl_coef = self.kl_ctl.value
+        for i in range(len(prompts)):
+            l = int(r_mask[i].sum())
+            rewards = -kl_coef * log_ratio[i, :l]
+            if dense:
+                ds = dense_scores[i]
+                rewards[: min(l, len(ds))] += ds[: min(l, len(ds))]
+            else:
+                rewards[l - 1] += scores[i]
+            ppo_rl_elements.append(
+                PPORLElement(
+                    query_tensor=np.asarray(prompts[i], np.int32),
+                    response_tensor=r_ids[i, :l],
+                    logprobs=logprobs[i, :l],
+                    values=values[i, :l],
+                    rewards=rewards.astype(np.float32),
+                )
+            )
+
 
     # ------------------------------------------------------------- train loop
 
