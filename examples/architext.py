@@ -1,0 +1,66 @@
+"""Architext: optimize textual interior designs for the fewest rooms (parity:
+`/root/reference/examples/architext.py` — same prompts, same reward). The
+reference's task is already fully self-contained (reward = -count of ":" room
+markers), so this runs identically offline with a byte tokenizer and a tiny
+random-init model (or a local checkpoint via ARCHITEXT_MODEL)."""
+
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+import trlx_tpu
+from examples.sentiment_task import TINY_MODEL_OVERRIDES
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_ppo_config
+
+
+def reward_fn(samples, **kwargs):
+    "Gives a negative count of rooms for each sample"
+    return [-sample.count(":") for sample in samples]
+
+
+PROMPTS = [
+    "[prompt] the bedroom is adjacent to the living room [layout]",
+    "[prompt] a bedroom is adjacent to the living room [layout]",
+    "[prompt] the bedroom is adjacent to the kitchen [layout]",
+    "[prompt] a bedroom is adjacent to the kitchen [layout]",
+    "[prompt] the kitchen is adjacent to the bathroom [layout]",
+    "[prompt] a bathroom is adjacent to the living room [layout]",
+    "[prompt] the bathroom is adjacent to the living room [layout]",
+    "[prompt] the bedroom is not adjacent to the living room [layout]",
+    "[prompt] a bedroom is not adjacent to the kitchen [layout]",
+    "[prompt] the kitchen is not adjacent to the bathroom [layout]",
+]
+
+
+def build_config() -> TRLConfig:
+    config = default_ppo_config()
+    config = config.evolve(
+        train={
+            "seq_length": 96, "batch_size": 16, "total_steps": 1000,
+            "checkpoint_dir": "ckpts/architext", "tracker": "jsonl",
+        },
+        method={"chunk_size": 16, "num_rollouts": 32,
+                "gen_kwargs": {"max_new_tokens": 24, "top_k": 0, "top_p": 1.0, "do_sample": True}},
+    )
+    model_path = os.environ.get("ARCHITEXT_MODEL", "architext/gptj-162M")
+    if os.path.isdir(model_path):
+        config.model.model_path = model_path
+        config.tokenizer.tokenizer_path = model_path
+    else:
+        config.model.model_path = "gptj"
+        config.model.model_overrides = dict(TINY_MODEL_OVERRIDES)
+        config.tokenizer.tokenizer_path = "bytes"
+    return config
+
+
+def main(hparams={}):
+    config = TRLConfig.update(build_config().to_dict(), hparams)
+    trlx_tpu.train(reward_fn=reward_fn, prompts=PROMPTS, eval_prompts=PROMPTS[:4], config=config)
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})

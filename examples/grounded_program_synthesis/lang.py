@@ -1,0 +1,81 @@
+"""Toy list-manipulation DSL + interpreter (parity:
+`/root/reference/examples/experiments/grounded_program_synthesis/lang.py` — a
+hand-rolled DSL whose interpreter grounds the reward). Programs are `;`-chained
+primitives applied to an integer list, e.g. ``reverse;add(2);take(3)``."""
+
+import json
+import random
+from typing import List, Optional
+
+
+class Interpreter:
+    """Evaluate a DSL program on a list; returns "ERROR" on any parse/run error
+    (the reference's sentinel)."""
+
+    PRIMS = ("reverse", "sort", "take", "drop", "add", "mul")
+
+    def __call__(self, code: str, xs: Optional[List[int]] = None):
+        try:
+            if xs is None:
+                return "ERROR"
+            out = list(xs)
+            for op in code.strip().split(";"):
+                op = op.strip()
+                if op == "reverse":
+                    out = out[::-1]
+                elif op == "sort":
+                    out = sorted(out)
+                elif op.startswith(("take(", "drop(", "add(", "mul(")) and op.endswith(")"):
+                    name, arg = op[:-1].split("(")
+                    n = int(arg)
+                    if name == "take":
+                        out = out[:n]
+                    elif name == "drop":
+                        out = out[n:]
+                    elif name == "add":
+                        out = [x + n for x in out]
+                    else:
+                        out = [x * n for x in out]
+                else:
+                    return "ERROR"
+            return out
+        except Exception:
+            return "ERROR"
+
+
+def random_program(rng: random.Random, max_ops: int = 3) -> str:
+    ops = []
+    for _ in range(rng.randint(1, max_ops)):
+        name = rng.choice(Interpreter.PRIMS)
+        if name in ("take", "drop", "add", "mul"):
+            ops.append(f"{name}({rng.randint(1, 4)})")
+        else:
+            ops.append(name)
+    return ";".join(ops)
+
+
+def format_sample(xs: List[int], output, code: str) -> str:
+    return f"Input: {json.dumps(xs)} Output: {json.dumps(output)} Function: {code}"
+
+
+def generate_dataset(n: int = 256, seed: int = 0, corrupt_frac: float = 0.25):
+    """(samples, rewards): correct programs get +1; corrupted ones (wrong
+    program for the stated output) get -1 — the interpreter grounds the label."""
+    rng = random.Random(seed)
+    interp = Interpreter()
+    samples, rewards = [], []
+    for _ in range(n):
+        xs = [rng.randint(0, 9) for _ in range(rng.randint(2, 5))]
+        code = random_program(rng)
+        output = interp(code, xs)
+        if output == "ERROR":
+            continue
+        if rng.random() < corrupt_frac:
+            wrong = random_program(rng)
+            if interp(wrong, xs) != output:
+                samples.append(format_sample(xs, output, wrong))
+                rewards.append(-1.0)
+                continue
+        samples.append(format_sample(xs, output, code))
+        rewards.append(1.0)
+    return samples, rewards

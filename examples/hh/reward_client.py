@@ -40,5 +40,10 @@ class RemoteRewardClient:
             payload = json.loads(resp.read())
         for tensor in payload.get("outputs", []):
             if tensor["name"] == "rewards":
-                return [float(x) for x in tensor["data"]]
+                rewards = [float(x) for x in tensor["data"]]
+                if len(rewards) != len(samples):
+                    raise RuntimeError(
+                        f"reward server returned {len(rewards)} rewards for {len(samples)} samples"
+                    )
+                return rewards
         raise RuntimeError(f"no 'rewards' tensor in response: {payload}")

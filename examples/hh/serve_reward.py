@@ -36,6 +36,10 @@ class RewardHandler(BaseHTTPRequestHandler):
             scores = lexicon_sentiment([str(s) for s in outputs])
             chosen = tensors.get("chosen")
             if chosen:
+                if len(chosen) != len(scores):
+                    raise ValueError(
+                        f"length mismatch: {len(scores)} outputs vs {len(chosen)} chosen"
+                    )
                 chosen_scores = lexicon_sentiment([str(s) for s in chosen])
                 scores = [s - c for s, c in zip(scores, chosen_scores)]
             body = json.dumps(

@@ -1,0 +1,84 @@
+"""T5 PPO for translation (parity: `/root/reference/examples/ppo_translation_t5.py`,
+which trains t5-large on WMT with a COMET reward). Zero-egress: a synthetic
+word-for-word dictionary translation task; the reward is token-level F1 against
+the reference translation (the COMET/BLEU stand-in). With local checkpoints and
+a dataset, swap PROMPTS/REFERENCES and the reward for the real pipeline."""
+
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+import trlx_tpu
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_ppo_config
+
+T5_TINY = dict(
+    vocab_size=259, d_model=64, d_kv=16, d_ff=256, num_layers=2,
+    num_decoder_layers=2, num_heads=4, decoder_start_token_id=1,
+)
+
+# toy "language": word-for-word dictionary (source -> target)
+LEXICON = {
+    "the": "le", "cat": "chat", "dog": "chien", "eats": "mange", "sees": "voit",
+    "a": "un", "fish": "poisson", "bird": "oiseau", "big": "grand", "small": "petit",
+}
+SENTENCES = [
+    "the cat eats a fish", "the dog sees a bird", "a big cat sees the dog",
+    "the small bird eats", "a dog eats the fish", "the big dog sees a cat",
+]
+PROMPTS = [f"translate: {s}" for s in SENTENCES]
+REFERENCES = {f"translate: {s}": " ".join(LEXICON[w] for w in s.split()) for s in SENTENCES}
+
+
+def token_f1(hyp: str, ref: str) -> float:
+    hyp_toks, ref_toks = hyp.split(), ref.split()
+    if not hyp_toks or not ref_toks:
+        return 0.0
+    common = 0
+    ref_pool = list(ref_toks)
+    for t in hyp_toks:
+        if t in ref_pool:
+            ref_pool.remove(t)
+            common += 1
+    p, r = common / len(hyp_toks), common / len(ref_toks)
+    return 0.0 if p + r == 0 else 2 * p * r / (p + r)
+
+
+def reward_fn(samples, prompts=None, outputs=None, **kwargs):
+    return [token_f1(out, REFERENCES.get(pr, "")) for pr, out in zip(prompts, outputs)]
+
+
+def build_config() -> TRLConfig:
+    config = default_ppo_config()
+    config = config.evolve(
+        train={
+            "seq_length": 64, "batch_size": 12, "total_steps": 2000,
+            "checkpoint_dir": "ckpts/ppo_translation_t5", "tracker": "jsonl",
+        },
+        method={"chunk_size": 12, "num_rollouts": 24,
+                "gen_kwargs": {"max_new_tokens": 32, "top_k": 0, "top_p": 1.0, "do_sample": True}},
+    )
+    config.model.model_arch_type = "seq2seq"
+    model_path = os.environ.get("T5_MODEL", "t5-large")
+    if os.path.isdir(model_path):
+        config.model.model_path = model_path
+        config.tokenizer.tokenizer_path = model_path
+    else:
+        config.model.model_path = "t5"
+        config.model.model_overrides = dict(T5_TINY)
+        config.tokenizer.tokenizer_path = "bytes"
+    return config
+
+
+def main(hparams={}):
+    config = TRLConfig.update(build_config().to_dict(), hparams)
+    trlx_tpu.train(
+        reward_fn=reward_fn, prompts=PROMPTS * 4, eval_prompts=PROMPTS, config=config
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})

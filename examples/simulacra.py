@@ -1,0 +1,86 @@
+"""Simulacra: optimize image prompts from prompt-rating pairs with ILQL (parity:
+`/root/reference/examples/simulacra.py`, which trains on the simulacra-aesthetic-
+captions sqlite db). Zero-egress: the same sqlite schema (ratings / images /
+generations) is synthesized in-memory with lexicon-scored ratings, and the exact
+reference SQL join pulls the training pairs; point SIMULACRA_DB at the real
+`sac_public_2022_06_29.sqlite` to run the original task."""
+
+import os
+import sqlite3
+import sys
+
+sys.path.insert(0, ".")
+
+import trlx_tpu
+from examples.sentiment_task import TINY_MODEL_OVERRIDES, lexicon_sentiment
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_ilql_config
+
+SYNTH_PROMPTS = [
+    "a good happy painting of a sunrise",
+    "a great wonderful landscape, beautiful light",
+    "blurry bad photo of nothing",
+    "a terrible awful sketch",
+    "a lovely excellent portrait, best quality",
+    "boring dull gray noise",
+    "a fine pleasant garden scene",
+    "worst ugly broken render",
+]
+
+
+def _synthesize_db() -> sqlite3.Connection:
+    conn = sqlite3.connect(":memory:")
+    c = conn.cursor()
+    c.execute("CREATE TABLE generations (id INTEGER PRIMARY KEY, prompt TEXT)")
+    c.execute("CREATE TABLE images (id INTEGER PRIMARY KEY, gid INTEGER)")
+    c.execute("CREATE TABLE ratings (iid INTEGER, rating REAL)")
+    for i, prompt in enumerate(SYNTH_PROMPTS * 4):
+        c.execute("INSERT INTO generations (id, prompt) VALUES (?, ?)", (i, prompt))
+        c.execute("INSERT INTO images (id, gid) VALUES (?, ?)", (i, i))
+        c.execute("INSERT INTO ratings (iid, rating) VALUES (?, ?)", (i, 5.0 + lexicon_sentiment([prompt])[0]))
+    conn.commit()
+    return conn
+
+
+def load_pairs():
+    dbpath = os.environ.get("SIMULACRA_DB", "sac_public_2022_06_29.sqlite")
+    conn = sqlite3.connect(dbpath) if os.path.exists(dbpath) else _synthesize_db()
+    c = conn.cursor()
+    c.execute(
+        "SELECT prompt, rating FROM ratings "
+        "JOIN images ON images.id=ratings.iid "
+        "JOIN generations ON images.gid=generations.id "
+        "WHERE rating IS NOT NULL;"
+    )
+    return tuple(map(list, zip(*c.fetchall())))
+
+
+def build_config() -> TRLConfig:
+    config = default_ilql_config()
+    config = config.evolve(
+        train={
+            "seq_length": 64, "batch_size": 16, "total_steps": 1000,
+            "checkpoint_dir": "ckpts/simulacra", "tracker": "jsonl",
+        },
+    )
+    config.model.model_path = "gpt2"
+    config.model.model_overrides = dict(TINY_MODEL_OVERRIDES)
+    config.tokenizer.tokenizer_path = "bytes"
+    return config
+
+
+def main(hparams={}):
+    config = TRLConfig.update(build_config().to_dict(), hparams)
+    prompts, ratings = load_pairs()
+    trlx_tpu.train(
+        samples=prompts,
+        rewards=ratings,
+        eval_prompts=["a painting of"] * 8,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})

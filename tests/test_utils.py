@@ -72,3 +72,48 @@ def test_flatten_dict():
 def test_significant():
     assert significant(0.0012345) == 0.00123
     assert significant(0) == 0
+
+
+def test_adamw_8bit_converges_and_shrinks_state():
+    """8-bit Adam reaches (near-)fp32 quality on a quadratic while its moment
+    state is ~4x smaller (reference parity: bnb 8-bit optimizers)."""
+    import jax
+    import optax
+
+    from trlx_tpu.ops.quantized_adam import adam_8bit
+
+    rng = np.random.default_rng(0)
+    target = jnp.asarray(rng.normal(size=(300,)), jnp.float32)
+
+    def loss(p):
+        return jnp.sum((p["w"] - target) ** 2)
+
+    def run(tx):
+        p = {"w": jnp.zeros(300, jnp.float32)}
+        s = tx.init(p)
+
+        @jax.jit
+        def step(p, s):
+            g = jax.grad(loss)(p)
+            updates, s2 = tx.update(g, s, p)
+            return optax.apply_updates(p, updates), s2
+
+        for _ in range(300):
+            p, s = step(p, s)
+        return float(loss(p)), s
+
+    loss32, state32 = run(optax.adam(0.05))
+    loss8, state8 = run(adam_8bit(0.05))
+    assert loss8 < 1e-3, loss8
+
+    def state_bytes(s):
+        return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(s))
+
+    assert state_bytes(state8) < 0.45 * state_bytes(state32), (
+        state_bytes(state8), state_bytes(state32),
+    )
+
+    # registry resolves the 8-bit names to the quantized implementation
+    tx = get_optimizer_class("adamw_8bit_bnb")(learning_rate=1e-3, weight_decay=0.01)
+    s = tx.init({"w": jnp.zeros(8)})
+    assert s["moments"]["w"]["m_q"].dtype == jnp.int8

@@ -192,6 +192,41 @@ def run_trials(
     while pending and len(running) < max_concurrent:
         launch(pending.pop(0))
 
+    try:
+        _event_loop(
+            script, trials, out_path, metric, mode, records, pending, running,
+            events, scheduler, launch,
+        )
+    finally:
+        # on any abort (Ctrl-C, scheduler error): ask surviving trials to stop
+        # via their stop files — the only sanctioned way to end a jax trial
+        # (signals can wedge a TPU chip claim) — and give them a grace period
+        for trial in list(running.values()):
+            try:
+                with open(trial.stop_path, "w") as f:
+                    f.write("sweep-aborted")
+            except OSError:
+                pass
+        for trial in list(running.values()):
+            try:
+                trial.proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                print(f"[sweep] trial {trial.idx} still running after abort request", flush=True)
+
+    _write_results(records, out_path)
+    scored = [t for t in records if (t.final_metrics or {}).get(metric) is not None]
+    best = None
+    if scored:
+        best = (max if mode == "max" else min)(scored, key=lambda t: t.final_metrics[metric])
+        print(
+            f"[sweep] best trial: {best.idx} {metric}={best.final_metrics[metric]} {best.hparams}"
+        )
+    if report_path:
+        _write_report(report_path, records, metric, mode, best)
+    return [_record_dict(t) for t in records]
+
+
+def _event_loop(script, trials, out_path, metric, mode, records, pending, running, events, scheduler, launch):
     while running:
         kind, trial, payload = events.get()
         if kind == "metric":
@@ -227,18 +262,6 @@ def run_trials(
             if pending:
                 launch(pending.pop(0))
 
-    _write_results(records, out_path)
-    scored = [t for t in records if (t.final_metrics or {}).get(metric) is not None]
-    best = None
-    if scored:
-        best = (max if mode == "max" else min)(scored, key=lambda t: t.final_metrics[metric])
-        print(
-            f"[sweep] best trial: {best.idx} {metric}={best.final_metrics[metric]} {best.hparams}"
-        )
-    if report_path:
-        _write_report(report_path, records, metric, mode, best)
-    return [_record_dict(t) for t in records]
-
 
 def _record_dict(t: _Trial) -> Dict[str, Any]:
     rec = {
@@ -252,8 +275,10 @@ def _record_dict(t: _Trial) -> Dict[str, Any]:
     if t.final_metrics is not None:
         rec["metrics"] = t.final_metrics
     if t.returncode not in (0, None) and os.path.exists(t.stderr_path):
-        with open(t.stderr_path) as f:
-            rec["stderr_tail"] = f.read()[-2000:]
+        with open(t.stderr_path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            f.seek(max(0, f.tell() - 2000))
+            rec["stderr_tail"] = f.read().decode(errors="replace")
     return rec
 
 

@@ -33,6 +33,7 @@ from trlx_tpu.pipeline.tokenization import load_tokenizer
 from trlx_tpu.trainer import BaseRLTrainer, register_trainer
 from trlx_tpu.utils import (
     Clock,
+    filter_non_scalars,
     get_git_tag,
     get_optimizer_class,
     get_scheduler_class,
@@ -479,24 +480,26 @@ class MeshRLTrainer(BaseRLTrainer):
         if not os.environ.get("TRLX_SWEEP"):
             return False
         if jax.process_index() == 0:
-            from trlx_tpu.utils import filter_non_scalars
-
             print(
                 "SWEEP_METRIC "
                 + json.dumps({"step": self.iter_count, **filter_non_scalars(results or {})}),
                 flush=True,
             )
-        # EVERY process polls the stop file (shared filesystem assumed), so a
-        # multi-process trial returns from learn() on all ranks together instead
-        # of deadlocking the mesh with rank 0 gone
+        # The stop decision must be COLLECTIVE: rank 0 reads the file and the
+        # result is broadcast, so every rank returns from learn() together (a
+        # per-rank filesystem poll could race the file's creation and leave the
+        # mesh with a missing participant)
         stop_file = os.environ.get("TRLX_SWEEP_STOP_FILE")
-        return bool(stop_file and os.path.exists(stop_file))
+        stop = bool(stop_file and os.path.exists(stop_file)) if jax.process_index() == 0 else False
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            stop = bool(multihost_utils.broadcast_one_to_all(jnp.asarray(stop)))
+        return stop
 
     def _report_sweep_result(self, results):
         """Final-metrics line consumed by the sweep runner (trlx_tpu/sweep.py)."""
         if os.environ.get("TRLX_SWEEP") and jax.process_index() == 0:
-            from trlx_tpu.utils import filter_non_scalars
-
             print("SWEEP_RESULT " + json.dumps(filter_non_scalars(results or {})), flush=True)
 
     # ------------------------------------------------------------- checkpoints

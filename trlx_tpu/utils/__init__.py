@@ -111,8 +111,10 @@ def infinite_loader(loader: Iterable) -> Iterator:
 
 
 class OptimizerName(str, Enum):
-    """Supported optimizer names (parity incl. 8-bit variants, which map to their
-    full-precision optax counterparts; true quantized states are a non-goal for now)."""
+    """Supported optimizer names. The 8-bit variants use true int8
+    blockwise-quantized moment states (:mod:`trlx_tpu.ops.quantized_adam`),
+    the TPU-native counterpart of the reference's bitsandbytes optimizers
+    (utils/__init__.py:104-123)."""
 
     ADAM = "adam"
     ADAMW = "adamw"
@@ -145,9 +147,21 @@ def get_optimizer_class(name) -> Any:
 
         return make
 
-    if name in (OptimizerName.ADAMW, OptimizerName.ADAMW_8BIT):
+    if name == OptimizerName.ADAMW:
         return _adamlike(optax.adamw)
-    if name in (OptimizerName.ADAM, OptimizerName.ADAM_8BIT):
+    if name == OptimizerName.ADAMW_8BIT:
+        from trlx_tpu.ops.quantized_adam import adamw_8bit
+
+        return _adamlike(adamw_8bit)
+    if name == OptimizerName.ADAM_8BIT:
+        from trlx_tpu.ops.quantized_adam import adam_8bit
+
+        def make_adam8(learning_rate, betas=(0.9, 0.999), eps=1e-8, **kw):
+            kw.pop("weight_decay", None)
+            return adam_8bit(learning_rate, b1=betas[0], b2=betas[1], eps=eps, **kw)
+
+        return make_adam8
+    if name == OptimizerName.ADAM:
 
         def make_adam(learning_rate, betas=(0.9, 0.999), eps=1e-8, **kw):
             kw.pop("weight_decay", None)
