@@ -41,16 +41,24 @@ def initialize_distributed(coordinator_address: Optional[str] = None) -> None:
     """Initialize multi-host JAX if running under a multi-process launcher.
 
     Replaces the reference's NCCL process-group init (`accelerate_base_trainer.py:56`)
-    and slurm/MPI env plumbing (`scripts/slurm_train.sh`). No-op when single-process
-    or already initialized.
+    and slurm/MPI env plumbing (`scripts/slurm_train.sh`). Env contract:
+    ``TRLX_NUM_PROCESSES`` + ``TRLX_COORDINATOR`` (host:port) + ``TRLX_PROCESS_ID``
+    for manual launches; on TPU pods jax auto-detects and only
+    ``TRLX_NUM_PROCESSES`` (or nothing) is needed. No-op when single-process or
+    already initialized.
     """
-    if jax.process_count() > 1:
-        return  # already initialized
+    # NB: do not probe jax.process_count() here — it would itself initialize
+    # the backend, making the jax.distributed.initialize below illegal
+    if jax.distributed.is_initialized():
+        return
     num_processes = os.environ.get("TRLX_NUM_PROCESSES")
+    coordinator_address = coordinator_address or os.environ.get("TRLX_COORDINATOR")
     if coordinator_address or num_processes:
+        process_id = os.environ.get("TRLX_PROCESS_ID")
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=int(num_processes) if num_processes else None,
+            process_id=int(process_id) if process_id is not None else None,
         )
         logger.info(
             f"jax.distributed initialized: process {jax.process_index()}/{jax.process_count()}",
@@ -118,9 +126,11 @@ def dp_size(mesh: Mesh) -> int:
 def put_batch(mesh: Mesh, batch):
     """Place a host-global numpy pytree onto the mesh, sharded along the batch dim.
 
-    In multi-host, each process holds the *full* global batch (single-controller style
-    data loading with identical seeds); ``jax.make_array_from_process_local_data``
-    carves out this host's shards.
+    In multi-host, each process holds the *full* global batch (single-controller
+    style data loading with identical seeds), so the array is assembled with
+    ``make_array_from_callback``: every host slices ITS devices' shards out of
+    the same global array. (``make_array_from_process_local_data`` would instead
+    treat each host's copy as a distinct portion and double the batch.)
     """
     dp = dp_size(mesh)
 
@@ -133,6 +143,6 @@ def put_batch(mesh: Mesh, batch):
             sharding = batch_sharding(mesh, extra_dims=x.ndim - 1)
         if jax.process_count() == 1:
             return jax.device_put(x, sharding)
-        return jax.make_array_from_process_local_data(sharding, x)
+        return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
 
     return jax.tree.map(_put, batch)

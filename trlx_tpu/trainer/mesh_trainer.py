@@ -55,9 +55,13 @@ class MeshRLTrainer(BaseRLTrainer):
 
     def __init__(self, config: TRLConfig, **kwargs):
         super().__init__(config, **kwargs)
-        self.np_rng = set_seed(config.train.seed)
-        self.rng = jax.random.PRNGKey(config.train.seed + jax.process_index())
+        # distributed init MUST precede any backend-initializing jax call
+        # (PRNGKey creation below queries devices)
         mesh_lib.initialize_distributed()
+        self.np_rng = set_seed(config.train.seed)
+        # identical on EVERY process: rng is a replicated jit input to generate,
+        # and jax requires replicated inputs to be equal across hosts
+        self.rng = jax.random.PRNGKey(config.train.seed)
         self.mesh = mesh_lib.mesh_from_config(config.mesh)
         self.tokenizer = load_tokenizer(config.tokenizer)
 
@@ -218,8 +222,11 @@ class MeshRLTrainer(BaseRLTrainer):
                     logits_processor=self.gen_logits_processor(),
                     **gen_kwargs,
                 )
+                # outputs replicated: every host must address the full result
+                # (host-side decode/reward runs identically on all processes)
                 self._compiled_generate[key] = jax.jit(
-                    lambda params, i, m, r: fn(params=params, input_ids=i, attention_mask=m, rng=r)
+                    lambda params, i, m, r: fn(params=params, input_ids=i, attention_mask=m, rng=r),
+                    out_shardings=mesh_lib.replicated(self.mesh),
                 )
             else:
                 step_fn, init_cache_fn = self.gen_step_fn()
@@ -232,7 +239,8 @@ class MeshRLTrainer(BaseRLTrainer):
                     **gen_kwargs,
                 )
                 self._compiled_generate[key] = jax.jit(
-                    lambda params, i, m, r: fn(params, input_ids=i, attention_mask=m, rng=r)
+                    lambda params, i, m, r: fn(params, input_ids=i, attention_mask=m, rng=r),
+                    out_shardings=mesh_lib.replicated(self.mesh),
                 )
         self.rng, sub = jax.random.split(self.rng)
         batch = mesh_lib.put_batch(self.mesh, {"ids": ids, "mask": mask})

@@ -306,7 +306,9 @@ class PPOTrainer(MeshRLTrainer):
                 ref_logprobs = logprobs_of_labels(ref_logits, r_ids)
                 return logprobs, values.astype(jnp.float32), ref_logprobs
 
-            self._score_fns[key] = jax.jit(score_s2s)
+            self._score_fns[key] = jax.jit(
+                score_s2s, out_shardings=mesh_lib.replicated(self.mesh)
+            )
             return self._score_fns[key]
 
         module, trunk = self.module, self.trunk_module
@@ -340,7 +342,9 @@ class PPOTrainer(MeshRLTrainer):
                 ref_logprobs[:, start : start + R],
             )
 
-        self._score_fns[key] = jax.jit(score)
+        self._score_fns[key] = jax.jit(
+            score, out_shardings=mesh_lib.replicated(self.mesh)
+        )
         return self._score_fns[key]
 
     def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0):

@@ -43,6 +43,11 @@ def train(
     if model_path:
         config.model.model_path = model_path
 
+    # multi-process init must precede any backend-initializing jax call
+    # (set_seed queries jax.process_index)
+    from trlx_tpu.parallel.mesh import initialize_distributed
+
+    initialize_distributed()
     set_seed(config.train.seed)
 
     if dataset is not None:

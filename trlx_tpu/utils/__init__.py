@@ -23,12 +23,17 @@ import optax
 
 
 def set_seed(seed: int) -> np.random.Generator:
-    """Seed python/numpy RNGs with a per-process offset (parity: reference seeds
-    ``seed + rank``) and return a numpy Generator for host-side sampling.
+    """Seed python/numpy RNGs and return a numpy Generator for host-side sampling.
 
-    JAX device RNG is explicit — trainers derive `jax.random.PRNGKey(seed)` themselves.
+    Deliberately NO per-process offset, unlike the reference's ``seed + rank``
+    (utils/__init__.py:44-52): under single-controller SPMD every process must
+    run the identical program on identical data — per-host divergence (in data
+    order, sampled tokens, anything feeding a jit input) is undefined behavior.
+    Per-sample generation diversity comes from the batched device RNG, not from
+    rank offsets. JAX device RNG is explicit — trainers derive
+    ``jax.random.PRNGKey(seed)`` themselves.
     """
-    seed = int(seed) + jax.process_index()
+    seed = int(seed)
     random.seed(seed)
     np.random.seed(seed % (2**32))
     return np.random.default_rng(seed)
