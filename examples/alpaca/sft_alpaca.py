@@ -1,0 +1,83 @@
+"""Instruction-tuning SFT on Alpaca-format data (parity:
+`/root/reference/examples/alpaca/sft_alpaca.py` — same prompt template and
+(prompt, output) dialog SFT). Zero-egress: a small synthetic instruction set;
+point ALPACA_JSON at a local alpaca-format json (list of {instruction, input,
+output}) to train on the real data."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+import trlx_tpu
+from examples.sentiment_task import TINY_MODEL_OVERRIDES
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_sft_config
+
+SYNTH_DATA = [
+    {"instruction": "List three colors.", "input": "", "output": "red, green, blue"},
+    {"instruction": "Add the numbers.", "input": "2 and 3", "output": "5"},
+    {"instruction": "Name a fruit.", "input": "", "output": "apple"},
+    {"instruction": "Reverse the word.", "input": "cat", "output": "tac"},
+    {"instruction": "Uppercase this.", "input": "dog", "output": "DOG"},
+    {"instruction": "Name an animal.", "input": "", "output": "a good dog"},
+]
+
+
+def preprocess(instruction: str, input: str, output: str):
+    """Build Alpaca prompt and output from instruction and input/output examples
+    (same template as the reference)."""
+    if input:
+        prefix = (
+            "Below is an instruction that describes a task, paired with an input that provides further context. "
+            "Write a response that appropriately completes the request."
+        )
+        prompt = f"{prefix}\n\n### Instruction:\n{instruction}\n\n### Input:\n{input}\n\n### Response:\n"
+    else:
+        prefix = (
+            "Below is an instruction that describes a task. Write a response that appropriately completes the request."
+        )
+        prompt = f"{prefix}\n\n### Instruction:\n{instruction}\n\n### Response:\n"
+    return [prompt, output]
+
+
+def load_data():
+    path = os.environ.get("ALPACA_JSON")
+    if path and os.path.exists(path):
+        with open(path) as f:
+            rows = json.load(f)
+    else:
+        rows = SYNTH_DATA * 8
+    return [preprocess(r["instruction"], r.get("input", ""), r["output"]) for r in rows]
+
+
+def build_config() -> TRLConfig:
+    config = default_sft_config()
+    config = config.evolve(
+        train={
+            "seq_length": 192, "batch_size": 8, "total_steps": 2400,
+            "checkpoint_dir": "ckpts/sft_alpaca", "tracker": "jsonl",
+        },
+        method={"gen_kwargs": {"max_new_tokens": 32, "do_sample": False}},
+    )
+    model_path = os.environ.get("ALPACA_MODEL", "EleutherAI/gpt-j-6B")
+    if os.path.isdir(model_path):
+        config.model.model_path = model_path
+        config.tokenizer.tokenizer_path = model_path
+    else:
+        config.model.model_path = "gptj"
+        config.model.model_overrides = dict(TINY_MODEL_OVERRIDES)
+        config.tokenizer.tokenizer_path = "bytes"
+    return config
+
+
+def main(hparams={}):
+    config = TRLConfig.update(build_config().to_dict(), hparams)
+    samples = load_data()
+    eval_prompts = [p for p, _ in samples[:8]]
+    trlx_tpu.train(samples=samples, eval_prompts=eval_prompts, config=config)
+
+
+if __name__ == "__main__":
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})

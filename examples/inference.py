@@ -1,0 +1,75 @@
+"""Load a trained checkpoint and generate (parity:
+`/root/reference/examples/nemo_ilql_inference.py` / `nemo_ppo_inference.py`,
+which load NeMo checkpoints for interactive generation). Works with either an
+``hf_model`` export directory (from ``save_pretrained``) or a random-init preset
+for smoke runs.
+
+Usage:
+    python examples/inference.py <model_dir_or_preset> [--tokenizer T] \
+        [--max-new-tokens N] [--prompt "..."] [--greedy]
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.hf_loading import init_params, load_pretrained
+from trlx_tpu.models.transformer import TransformerLM
+from trlx_tpu.ops.generation import generate, left_pad_batch, pad_to_bucket
+from trlx_tpu.pipeline.tokenization import load_tokenizer
+from trlx_tpu.data.configs import TokenizerConfig
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("model", help="hf_model export dir, local HF dir, or family preset")
+    parser.add_argument("--tokenizer", default="bytes")
+    parser.add_argument("--max-new-tokens", type=int, default=32)
+    parser.add_argument("--prompt", action="append", default=None)
+    parser.add_argument("--greedy", action="store_true")
+    parser.add_argument("--temperature", type=float, default=1.0)
+    parser.add_argument("--top-k", type=int, default=0)
+    parser.add_argument("--top-p", type=float, default=1.0)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+
+    config, params, model_type = load_pretrained(args.model, overrides={"compute_dtype": jnp.float32})
+    model = TransformerLM(config)
+    if params is None:
+        params = init_params(config, model, seed=args.seed)
+    tokenizer = load_tokenizer(TokenizerConfig(tokenizer_path=args.tokenizer))
+
+    prompts = args.prompt or ["Hello, my name is", "The capital of France is"]
+    ids_list = [np.asarray(tokenizer(p).input_ids, np.int32) for p in prompts]
+    P = pad_to_bucket(max(len(i) for i in ids_list), [2 ** i for i in range(3, 14)])
+    ids, mask = left_pad_batch(ids_list, tokenizer.pad_token_id, P)
+
+    def step(p, t_ids, t_mask, positions, cache):
+        logits, hidden, _, cache = model.apply({"params": p}, t_ids, t_mask, positions, cache)
+        return logits, hidden, cache
+
+    out = jax.jit(
+        lambda p, i, m, r: generate(
+            step, p, lambda b, s: model.init_cache(b, s), i, m, r,
+            max_new_tokens=args.max_new_tokens,
+            eos_token_id=tokenizer.eos_token_id, pad_token_id=tokenizer.pad_token_id,
+            do_sample=not args.greedy, temperature=args.temperature,
+            top_k=args.top_k, top_p=args.top_p,
+        )
+    )(params, jnp.asarray(ids), jnp.asarray(mask), jax.random.PRNGKey(args.seed))
+
+    seqs = np.asarray(out["sequences"])
+    for i, prompt in enumerate(prompts):
+        completion = tokenizer.decode(seqs[i, P:], skip_special_tokens=True)
+        print(f"--- {model_type} ---")
+        print(prompt + completion)
+
+
+if __name__ == "__main__":
+    main()

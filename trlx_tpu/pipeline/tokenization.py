@@ -70,7 +70,11 @@ class CharTokenizer:
         out = []
         for i in map(int, ids):
             if i >= self._offset:
-                out.append(self._id_to_char[i])
+                # ids beyond the alphabet (a model with a larger vocab than
+                # this tokenizer) are dropped rather than crashing the decode
+                ch = self._id_to_char.get(i)
+                if ch is not None:
+                    out.append(ch)
             elif not skip_special_tokens:
                 out.append({0: self.pad_token, 1: self.bos_token, 2: self.eos_token}[i])
         return "".join(out)
@@ -101,12 +105,14 @@ class ByteTokenizer(CharTokenizer):
                 byte_run.clear()
 
         for i in map(int, ids):
-            if i >= self._offset:
+            if self._offset <= i < self._offset + 256:
                 byte_run.append(i - self._offset)
-            else:
+            elif i < self._offset:
                 flush()
                 if not skip_special_tokens:
                     out.append(specials[i])
+            # ids beyond the byte range (e.g. a model with a larger vocab than
+            # this tokenizer) are dropped rather than crashing the decode
         flush()
         return "".join(out)
 

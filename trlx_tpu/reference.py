@@ -25,27 +25,63 @@ def tree_hash() -> str:
         return "unknown"
 
 
-def run_suite(output: str):
-    """Run bench.py (the randomwalks PPO workload) and store its metric."""
-    t0 = time.time()
-    proc = subprocess.run([sys.executable, "bench.py"], capture_output=True, text=True)
-    metrics = {}
-    for line in reversed(proc.stdout.splitlines()):
+def run_suite(output: str, rev: str = None):
+    """Run bench.py (the randomwalks PPO workload) and store its metric.
+
+    With ``rev``, the suite runs against that git revision in a temporary
+    worktree — the local counterpart of the reference's clone-two-branches
+    benchmark (`trlx/reference.py:34-49`, `scripts/benchmark.sh`)."""
+    import os
+    import shutil
+    import tempfile
+
+    cwd = os.getcwd()
+    worktree = None
+    try:
+        if rev:
+            safe = rev[:12].replace("/", "-")
+            worktree = tempfile.mkdtemp(prefix=f"trlx_bench_{safe}_")
+            added = subprocess.run(
+                ["git", "worktree", "add", "--detach", worktree, rev],
+                capture_output=True, text=True,
+            )
+            if added.returncode != 0:
+                raise RuntimeError(f"git worktree add {rev!r} failed: {added.stderr.strip()}")
+            cwd = worktree
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, "bench.py"], capture_output=True, text=True, cwd=cwd
+        )
+        metrics = {}
+        for line in reversed(proc.stdout.splitlines()):
+            try:
+                metrics = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
         try:
-            metrics = json.loads(line)
-            break
-        except json.JSONDecodeError:
-            continue
-    record = {
-        "tree_hash": tree_hash(),
-        "time": time.time(),
-        "seconds": round(time.time() - t0, 1),
-        "metrics": metrics,
-        "returncode": proc.returncode,
-    }
+            th = subprocess.check_output(
+                ["git", "rev-parse", f"{rev}^{{tree}}" if rev else "HEAD^{tree}"], text=True
+            ).strip()
+        except Exception:
+            th = "unknown"
+        record = {
+            "rev": rev or "HEAD",
+            "tree_hash": th,
+            "time": time.time(),
+            "seconds": round(time.time() - t0, 1),
+            "metrics": metrics,
+            "returncode": proc.returncode,
+        }
+    finally:
+        if worktree:
+            subprocess.run(["git", "worktree", "remove", "--force", worktree],
+                           capture_output=True)
+            shutil.rmtree(worktree, ignore_errors=True)
     with open(output, "w") as f:
         json.dump(record, f, indent=2)
     print(json.dumps(record))
+    return record
 
 
 def diff(a_path: str, b_path: str):
@@ -67,13 +103,20 @@ def main():
     sub = parser.add_subparsers(dest="cmd", required=True)
     p_run = sub.add_parser("run")
     p_run.add_argument("--output", default=None)
+    p_run.add_argument("--rev", default=None, help="git revision to benchmark in a temp worktree")
     p_diff = sub.add_parser("diff")
     p_diff.add_argument("a")
     p_diff.add_argument("b")
+    p_cmp = sub.add_parser("compare", help="benchmark HEAD and REV, then diff")
+    p_cmp.add_argument("rev")
     args = parser.parse_args()
     if args.cmd == "run":
         out = args.output or f"bench_{tree_hash()[:12]}.json"
-        run_suite(out)
+        run_suite(out, rev=args.rev)
+    elif args.cmd == "compare":
+        run_suite("bench_rev.json", rev=args.rev)
+        run_suite("bench_head.json")
+        diff("bench_rev.json", "bench_head.json")
     else:
         diff(args.a, args.b)
 
