@@ -149,6 +149,31 @@ def test_ppo_peft_end_to_end(tmp_path, peft_config):
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("family", ["bloom", "gpt_bigcode"])
+def test_ppo_new_families_end_to_end(tmp_path, family):
+    """Full PPO (incl. hydra frozen branch) on the ALiBi and MQA families."""
+    kwargs = base_kwargs(tmp_path, "PPOTrainer")
+    overrides = dict(TINY_MODEL)
+    overrides.pop("intermediate_size", None)
+    # the gpt_bigcode preset already carries num_kv_heads=1 (MQA)
+    kwargs["model"] = ModelConfig(
+        model_path=family, num_layers_unfrozen=1, model_overrides=overrides
+    )
+    config = TRLConfig(
+        method=PPOConfig(
+            num_rollouts=8, chunk_size=4, ppo_epochs=1, init_kl_coef=0.01,
+            target=None, gen_kwargs=dict(max_new_tokens=6, do_sample=True, top_k=0, top_p=1.0),
+        ),
+        **kwargs,
+    )
+    trainer = trlx_tpu.train(
+        reward_fn=dog_reward, prompts=["ab", "cd ef", "gh", "a b c"] * 2,
+        eval_prompts=["ab"], config=config,
+    )
+    assert trainer.iter_count >= 3
+
+
+@pytest.mark.slow
 def test_ppo_overlap_reward_scoring(tmp_path):
     """Double-buffered rollouts: reward_fn for chunk i runs on a worker thread
     while chunk i+1 generates; results must be complete and ordered."""
