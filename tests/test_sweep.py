@@ -6,6 +6,11 @@ import time
 
 from trlx_tpu.sweep import AshaScheduler, generate_trials, run_trials
 
+# The session environment may register a (single-claim) TPU in every python
+# subprocess via sitecustomize; a held chip then stalls each trial's interpreter
+# startup by ~15s. The fake trials never touch jax — neutralize the gate var.
+NO_TPU_ENV = {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"}
+
 FAKE_TRIAL = '''
 import json, os, sys, time
 hp = json.loads(sys.argv[1])
@@ -53,7 +58,7 @@ def test_asha_executor_stops_bad_trials(tmp_path):
     report = str(tmp_path / "report.md")
     results = run_trials(
         str(script), trials, out, "reward/mean", "max",
-        max_concurrent=1, scheduler=sched, report_path=report,
+        max_concurrent=1, scheduler=sched, report_path=report, extra_env=NO_TPU_ENV,
     )
     assert [r["returncode"] for r in results] == [0, 0, 0]
     assert not results[0]["early_stopped"]
@@ -73,7 +78,7 @@ def test_parallel_executor_overlaps_trials(tmp_path):
     t0 = time.time()
     results = run_trials(
         str(script), trials, str(tmp_path / "res.jsonl"), "reward/mean", "max",
-        max_concurrent=4,
+        max_concurrent=4, extra_env=NO_TPU_ENV,
     )
     wall = time.time() - t0
     assert all(r["returncode"] == 0 for r in results)

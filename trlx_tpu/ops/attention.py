@@ -30,7 +30,7 @@ NEG_INF = -1e30
 
 
 def _flash_kernel(
-    kv_valid_ref,  # [1, block_k] int32 (prefetched per kv block)
+    kv_valid_ref,  # [1, 1, 8, block_k] int32 (sublane-replicated, per kv block)
     q_ref,  # [1, 1, block_q, D]
     k_ref,  # [1, 1, block_k, D]
     v_ref,  # [1, 1, block_k, D]
@@ -70,7 +70,7 @@ def _flash_kernel(
 
         q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        mask = kv_valid_ref[0][None, :] > 0
+        mask = kv_valid_ref[0, 0, 0][None, :] > 0
         if causal:
             mask = jnp.logical_and(mask, k_pos <= q_pos)
         s = jnp.where(mask, s, NEG_INF)
@@ -140,6 +140,17 @@ def _flash_padded(q, k, v, kv_valid, causal, scale, block_q, block_k, interpret)
     kv_steps = S // block_k
     grid = (B, H, T // block_q, kv_steps)
 
+    # Mosaic tiling rules: a block's last dim must be a multiple of 128 or equal
+    # the array's dim; its second-to-last a multiple of 8 or equal. A [B, S] mask
+    # blocked (1, block_k) satisfies neither when block_k < 128 (observed as a
+    # real-TPU lowering failure in round 2's bench — interpret mode on CPU never
+    # checks). Reshape to [B, kv_steps, 8, block_k] (sublane-replicated): the
+    # block (1, 1, 8, block_k) then tiles legally and costs 8·S int32 per row.
+    kv_valid_tiled = jnp.broadcast_to(
+        kv_valid.astype(jnp.int32).reshape(B, kv_steps, 1, block_k),
+        (B, kv_steps, 8, block_k),
+    )
+
     kernel = functools.partial(
         _flash_kernel,
         causal=causal,
@@ -152,7 +163,7 @@ def _flash_padded(q, k, v, kv_valid, causal, scale, block_q, block_k, interpret)
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, j)),  # kv_valid
+            pl.BlockSpec((1, 1, 8, block_k), lambda b, h, i, j: (b, j, 0, 0)),  # kv_valid
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
@@ -165,7 +176,7 @@ def _flash_padded(q, k, v, kv_valid, causal, scale, block_q, block_k, interpret)
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
         interpret=interpret,
-    )(kv_valid.astype(jnp.int32), q, k, v)
+    )(kv_valid_tiled, q, k, v)
 
 
 def xla_attention(q, k, v, kv_valid, causal: bool, scale: float) -> jnp.ndarray:

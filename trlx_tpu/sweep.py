@@ -167,6 +167,7 @@ def run_trials(
     max_concurrent: int = 1,
     scheduler: Optional[AshaScheduler] = None,
     report_path: Optional[str] = None,
+    extra_env: Optional[Dict[str, str]] = None,
 ):
     records: List[_Trial] = [
         _Trial(i, hp, out_path + f".stop{i}") for i, hp in enumerate(trials)
@@ -178,6 +179,7 @@ def run_trials(
     def launch(trial: _Trial):
         print(f"[sweep] trial {trial.idx + 1}/{len(trials)}: {trial.hparams}", flush=True)
         env = dict(os.environ, TRLX_SWEEP="1", TRLX_SWEEP_STOP_FILE=trial.stop_path)
+        env.update(extra_env or {})
         if os.path.exists(trial.stop_path):
             os.remove(trial.stop_path)
         trial.t0 = time.time()
