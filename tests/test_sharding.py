@@ -20,7 +20,7 @@ from trlx_tpu.parallel.sharding import (
 
 def test_make_mesh_infers_axis():
     mesh = make_mesh(data=-1, fsdp=2, model=2)
-    assert mesh.shape == {"data": 2, "fsdp": 2, "model": 2}
+    assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "pipe": 1, "model": 2}
     assert dp_size(mesh) == 4
 
 

@@ -142,11 +142,18 @@ class MeshConfig:
     """TPU-first device-mesh / sharding config (no reference equivalent — replaces
     accelerate/deepspeed YAMLs and NeMo's TP/PP sizes, cf. SURVEY.md §2.3).
 
-    The mesh has up to three axes: ``data`` (pure DP), ``fsdp`` (ZeRO-style param/opt
-    sharding, also used as a second data axis), and ``model`` (tensor parallel).
-    Axis sizes of -1 mean "infer from device count" (at most one axis may be -1).
+    The mesh has up to four axes: ``data`` (pure DP), ``fsdp`` (ZeRO-style param/opt
+    sharding, also used as a second data axis), ``pipe`` (pipeline parallelism:
+    transformer layers stacked ``[L, ...]`` and sharded into stages, GPipe microbatch
+    schedule over ``ppermute`` — the analogue of the reference's Apex pipeline engine,
+    modeling_nemo_ppo.py:713-731), and ``model`` (tensor parallel). Axis sizes of -1
+    mean "infer from device count" (at most one axis may be -1).
 
-    :param data / fsdp / model: mesh axis sizes.
+    :param data / fsdp / pipe / model: mesh axis sizes.
+    :param pipeline_microbatches: microbatches per pipelined forward (``pipe > 1``
+        only). If the per-step batch does not divide evenly, the largest divisor
+        <= this value is used instead (with a warning). Bubble fraction is
+        ``(pipe-1)/(microbatches+pipe-1)``.
     :param remat: rematerialization policy: ``"none"`` | ``"full"`` |
         ``"nothing_saveable"`` | ``"dots_saveable"``.
     :param param_dtype: dtype params are stored in.
@@ -158,7 +165,9 @@ class MeshConfig:
 
     data: int = -1
     fsdp: int = 1
+    pipe: int = 1
     model: int = 1
+    pipeline_microbatches: int = 4
     remat: str = "none"
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from trlx_tpu.parallel.mesh import BATCH_AXES, MODEL_AXIS
+from trlx_tpu.parallel.mesh import BATCH_AXES, MODEL_AXIS, PIPE_AXIS
 from trlx_tpu.parallel.sharding import (
     ambient_mesh,
     batch_divisible,
@@ -83,6 +83,14 @@ class TransformerConfig:
     compute_dtype: Any = jnp.bfloat16
     remat: str = "none"  # "none" | "full" | "nothing_saveable" | "dots_saveable"
     attention_impl: str = "xla"  # "xla" | "flash" (Pallas) | "ring" (sequence-parallel)
+    # Pipeline parallelism (the reference's Apex pipeline engine analogue,
+    # modeling_nemo_ppo.py:713-731). > 1 stores block params STACKED ([L, ...]
+    # under "layers_scan", sharded over the mesh "pipe" axis) and runs cache-free
+    # forwards as a GPipe microbatch schedule over ppermute; cached decode runs a
+    # sequential layer scan (layer shards streamed — the NeMo analogue toggles PP
+    # scheduling off for inference too, modeling_nemo_ppo.py:838-870).
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 4
     # Megatron-SP analogue: shard the residual stream's sequence dim over the
     # `model` axis between blocks (reference sequence_parallel cfg,
     # modeling_nemo_ppo.py:160-164). Applied on cache-free forwards.
@@ -113,6 +121,16 @@ class TransformerConfig:
 
     def replace(self, **kw) -> "TransformerConfig":
         return replace(self, **kw)
+
+
+def remat_policy(name: str):
+    """Rematerialization policy by config name (shared by the listed-layer stack
+    and the pipelined stage scan)."""
+    return {
+        "full": None,
+        "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+        "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    }[name]
 
 
 def _act(name: str):
@@ -472,13 +490,38 @@ class TransformerLM(nn.Module):
             )
         block = Block
         if c.remat != "none":
-            policy = {
-                "full": None,
-                "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
-                "dots_saveable": jax.checkpoint_policies.dots_saveable,
-            }[c.remat]
-            block = nn.remat(Block, policy=policy)
-        self.layers = [block(c) for _ in range(c.num_layers)]
+            block = nn.remat(Block, policy=remat_policy(c.remat))
+        if c.pipeline_stages > 1:
+            if c.num_layers % c.pipeline_stages != 0:
+                raise ValueError(
+                    f"num_layers={c.num_layers} not divisible by "
+                    f"pipeline_stages={c.pipeline_stages}"
+                )
+            if c.attention_impl == "ring":
+                raise ValueError(
+                    "pipeline_stages > 1 cannot nest ring attention's shard_map; "
+                    "use attention_impl='xla' or 'flash'"
+                )
+            if c.sequence_sharding:
+                raise ValueError(
+                    "pipeline_stages > 1 does not apply sequence-sharding "
+                    "constraints inside the pipelined stack; set "
+                    "sequence_sharding=False (the trainer does this automatically "
+                    "when mesh.pipe > 1)"
+                )
+            # stacked layout: one scanned Block whose params carry a leading
+            # [num_layers] dim (sharded over "pipe" by the partition rules)
+            self.layers_scan = nn.scan(
+                block,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast, 0, nn.broadcast),
+                out_axes=0,
+                length=c.num_layers,
+            )(c, name="layers_scan")
+            self.layers = ()
+        else:
+            self.layers = [block(c) for _ in range(c.num_layers)]
         if c.final_norm:
             self.ln_f = _norm_module(c)
         if not c.tie_word_embeddings:
@@ -609,18 +652,34 @@ class TransformerLM(nn.Module):
             x = constrain_seq(x)
         captures = {}
         branch_hidden = None
-        new_layer_caches = []
-        for i, layer in enumerate(self.layers):
-            if i in capture_set:
-                captures[i] = x
-            layer_cache = None
+        if c.pipeline_stages > 1:
+            if capture_set:
+                raise NotImplementedError(
+                    "stacked/pipelined models do not support hydra branch capture "
+                    "(per-layer activations are internal to the stage scan); use a "
+                    "separate reference model (num_layers_unfrozen=-1) and "
+                    "num_value_layers_unfrozen=0"
+                )
+            x, stacked_kv = self._apply_stacked(x, mask_bias, layer_positions, cache, kv_valid)
+        else:
+            new_layer_caches = []
+            for i, layer in enumerate(self.layers):
+                if i in capture_set:
+                    captures[i] = x
+                layer_cache = None
+                if cache is not None:
+                    layer_cache = {"k": cache["k"][i], "v": cache["v"][i], "index": cache["index"]}
+                x, new_lc = layer(x, mask_bias, layer_positions, layer_cache, kv_valid)
+                if seq_shard:
+                    x = constrain_seq(x)
+                if cache is not None:
+                    new_layer_caches.append(new_lc)
+            stacked_kv = None
             if cache is not None:
-                layer_cache = {"k": cache["k"][i], "v": cache["v"][i], "index": cache["index"]}
-            x, new_lc = layer(x, mask_bias, layer_positions, layer_cache, kv_valid)
-            if seq_shard:
-                x = constrain_seq(x)
-            if cache is not None:
-                new_layer_caches.append(new_lc)
+                stacked_kv = {
+                    "k": jnp.stack([lc["k"] for lc in new_layer_caches]),
+                    "v": jnp.stack([lc["v"] for lc in new_layer_caches]),
+                }
         if seq_shard:
             # gather the sequence dim before heads (Megatron's
             # gather_from_sequence_parallel_region analogue)
@@ -632,8 +691,8 @@ class TransformerLM(nn.Module):
         new_cache = None
         if cache is not None:
             new_cache = {
-                "k": jnp.stack([lc["k"] for lc in new_layer_caches]),
-                "v": jnp.stack([lc["v"] for lc in new_layer_caches]),
+                "k": stacked_kv["k"],
+                "v": stacked_kv["v"],
                 "index": cache["index"] + T + nv_rows,
             }
         if branch_layer is not None and not isinstance(branch_layer, tuple):
@@ -641,6 +700,35 @@ class TransformerLM(nn.Module):
         else:
             branch_out = captures if isinstance(branch_layer, tuple) else None
         return logits, hidden, branch_out, new_cache
+
+    def _apply_stacked(self, x, mask_bias, positions, cache, kv_valid):
+        """Run the stacked block stack (``pipeline_stages > 1`` layout).
+
+        Cached decode → sequential ``nn.scan`` over the stacked params (each
+        layer's shard is streamed to where it's needed; the NeMo reference
+        likewise drops pipeline scheduling for inference,
+        modeling_nemo_ppo.py:838-870). Cache-free forwards → the GPipe
+        microbatch schedule over the mesh's ``pipe`` axis when one is active.
+        Returns (x, stacked_kv or None)."""
+        c = self.config
+        if cache is not None:
+            scan_cache = {
+                "k": cache["k"],
+                "v": cache["v"],
+                "index": jnp.broadcast_to(cache["index"], (c.num_layers,)),
+            }
+            x, ys = self.layers_scan(x, mask_bias, positions, scan_cache, kv_valid)
+            return x, ys
+        if not self.is_initializing():
+            mesh = ambient_mesh()
+            if mesh is not None and mesh.shape.get(PIPE_AXIS, 1) > 1:
+                from trlx_tpu.parallel.pipeline import pipeline_apply
+
+                stack = self.variables["params"]["layers_scan"]
+                x = pipeline_apply(c, stack, x, mask_bias, positions, kv_valid, mesh)
+                return x, None
+        x, _ = self.layers_scan(x, mask_bias, positions, None, kv_valid)
+        return x, None
 
     def forward_from(
         self,
@@ -653,6 +741,11 @@ class TransformerLM(nn.Module):
         This is the hydra frozen-branch forward (reference ``forward_hydra``,
         modeling_ppo.py:410-453) — called with the frozen param subtree via
         ``apply({"params": frozen}, ..., method="forward_from")``."""
+        if self.config.pipeline_stages > 1:
+            raise NotImplementedError(
+                "hydra branch forwards need per-layer params; pipelined models "
+                "use a separate reference model (num_layers_unfrozen=-1)"
+            )
         B, T, _ = hidden.shape
         default_positions, mask_bias = make_attn_bias(self.config, attention_mask, B, T)
         if positions is None:

@@ -4,6 +4,7 @@ from trlx_tpu.parallel.mesh import (
     FSDP_AXIS,
     MESH_AXES,
     MODEL_AXIS,
+    PIPE_AXIS,
     batch_sharding,
     batch_spec,
     dp_size,

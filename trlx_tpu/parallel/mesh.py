@@ -29,8 +29,11 @@ logger = logging.get_logger(__name__)
 
 DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
+PIPE_AXIS = "pipe"
 MODEL_AXIS = "model"
-MESH_AXES = (DATA_AXIS, FSDP_AXIS, MODEL_AXIS)
+# pipe sits between fsdp and model so that model (TP, chattiest) maps to
+# physically-adjacent chips and pipe's stage-to-stage ppermute rides ICI too
+MESH_AXES = (DATA_AXIS, FSDP_AXIS, PIPE_AXIS, MODEL_AXIS)
 
 # Batch dims are sharded over both data axes (data-parallel + fsdp act as a combined
 # data axis for inputs, the standard JAX FSDP recipe).
@@ -70,9 +73,10 @@ def make_mesh(
     data: int = -1,
     fsdp: int = 1,
     model: int = 1,
+    pipe: int = 1,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
-    """Build the global ``data × fsdp × model`` mesh.
+    """Build the global ``data × fsdp × pipe × model`` mesh.
 
     Any axis given as -1 is inferred from the device count (at most one). Axis
     products must equal the number of devices. ``mesh_utils.create_device_mesh``
@@ -81,7 +85,7 @@ def make_mesh(
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    sizes = [data, fsdp, model]
+    sizes = [data, fsdp, pipe, model]
     unknown = [i for i, s in enumerate(sizes) if s == -1]
     if len(unknown) > 1:
         raise ValueError(f"At most one mesh axis may be -1, got {sizes}")
@@ -94,14 +98,18 @@ def make_mesh(
         raise ValueError(f"Mesh {sizes} does not match device count {n}")
     device_array = mesh_utils.create_device_mesh(sizes, devices=devices)
     mesh = Mesh(device_array, MESH_AXES)
-    logger.info(f"Mesh: data={sizes[0]} fsdp={sizes[1]} model={sizes[2]} over {n} devices")
+    logger.info(
+        f"Mesh: data={sizes[0]} fsdp={sizes[1]} pipe={sizes[2]} model={sizes[3]} "
+        f"over {n} devices"
+    )
     return mesh
 
 
 def mesh_from_config(mesh_config, devices: Optional[Sequence] = None) -> Mesh:
     """Build a mesh from a :class:`trlx_tpu.data.configs.MeshConfig`."""
     return make_mesh(
-        data=mesh_config.data, fsdp=mesh_config.fsdp, model=mesh_config.model, devices=devices
+        data=mesh_config.data, fsdp=mesh_config.fsdp, model=mesh_config.model,
+        pipe=mesh_config.pipe, devices=devices,
     )
 
 

@@ -1,0 +1,201 @@
+"""Pipeline parallelism: a GPipe microbatch schedule over the mesh's ``pipe`` axis.
+
+The reference drives pipeline parallelism through NVIDIA Apex's fwd/bwd microbatch
+engine with P2P sends between stages (`/root/reference/trlx/models/modeling_nemo_ppo.py:713-731`,
+stage-sliced model construction `:497-536`, inter-stage tensor hand-off `:199`). The
+TPU-native equivalent here is a *single SPMD program*: transformer block params are
+stacked ``[num_layers, ...]`` and sharded over the ``pipe`` mesh axis (each stage
+holds ``num_layers/pipe`` layers), and the schedule is a ``lax.scan`` over
+``num_microbatches + stages - 1`` ticks inside a ``jax.shard_map`` that is manual
+over ``pipe`` only — activations rotate stage-to-stage with ``ppermute`` over ICI
+while the ``data``/``fsdp``/``model`` axes stay under automatic SPMD partitioning
+(so FSDP + TP compose with PP, like Megatron's TPxPPxDP grid).
+
+Schedule (GPipe): at tick ``t`` stage ``s`` processes microbatch ``t - s``; stage 0
+injects microbatch ``t``; the last stage's output at tick ``t`` is microbatch
+``t - (stages-1)``'s result. All stages run every tick (SPMD), so warmup/drain
+ticks compute garbage that is simply never written out — the classic bubble,
+fraction ``(stages-1)/(ticks)``. The backward pass is jax.grad through the scan:
+ppermute transposes to the reverse rotation, giving the mirrored drain schedule
+without any hand-written pipeline backward.
+"""
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+from trlx_tpu.parallel.mesh import PIPE_AXIS
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+def stack_layer_params(tree: Dict[str, Any], num_layers: int) -> Dict[str, Any]:
+    """Convert a listed-layers param tree (``layers_0`` .. ``layers_{L-1}``, the
+    layout produced by HF checkpoint loading) into the stacked layout
+    (``layers_scan`` with a leading ``[L]`` dim) used when ``pipeline_stages > 1``.
+    Host-side numpy; leaves are copies."""
+    t = dict(tree)
+    layers = [t.pop(f"layers_{i}") for i in range(num_layers)]
+    t["layers_scan"] = jax.tree.map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]), *layers
+    )
+    return t
+
+
+def unstack_layer_params(tree: Dict[str, Any], num_layers: int) -> Dict[str, Any]:
+    """Inverse of :func:`stack_layer_params` (for HF export / hydra extraction)."""
+    t = dict(tree)
+    stack = t.pop("layers_scan")
+    for i in range(num_layers):
+        t[f"layers_{i}"] = jax.tree.map(lambda x: np.asarray(x)[i], stack)
+    return t
+
+
+def pick_microbatches(batch: int, requested: int) -> int:
+    """Largest microbatch count <= requested that divides the batch."""
+    m = max(1, min(requested, batch))
+    while batch % m:
+        m -= 1
+    return m
+
+
+def pipeline_apply(
+    config,
+    stack_params: Dict[str, Any],
+    x: jnp.ndarray,  # [B, T, H] block-stack input (post-embed)
+    mask_bias: jnp.ndarray,  # [B or 1, 1, T, T]
+    positions: jnp.ndarray,  # [B, T]
+    kv_valid: Optional[jnp.ndarray],  # [B, T] or None
+    mesh: Mesh,
+) -> jnp.ndarray:
+    """Run the stacked block stack as a pipelined SPMD program. Returns the final
+    residual-stream activation [B, T, H]."""
+    from trlx_tpu.models.transformer import Block, remat_policy
+
+    c = config
+    stages = int(mesh.shape[PIPE_AXIS])
+    B = x.shape[0]
+    num_mb = pick_microbatches(B, c.pipeline_microbatches)
+    if num_mb != c.pipeline_microbatches:
+        logger.warning(
+            f"batch {B} does not divide into pipeline_microbatches="
+            f"{c.pipeline_microbatches}; running {num_mb} microbatches "
+            f"(bubble fraction {(stages - 1) / (num_mb + stages - 1):.2f})"
+        )
+    if c.pipeline_stages != stages:
+        raise ValueError(
+            f"pipeline_stages={c.pipeline_stages} does not match the mesh's "
+            f"pipe axis size {stages}"
+        )
+
+    # parent=None: pipeline_apply runs inside TransformerLM's apply, where a bare
+    # Block(c) would register as a submodule; this block is a detached applier
+    # over explicit param slices instead
+    block = Block(c, parent=None)
+
+    def one_layer(h, layer_p, mask_mb, pos_mb, kv_mb):
+        out, _ = block.apply({"params": layer_p}, h, mask_mb, pos_mb, None, kv_mb)
+        return out
+
+    if c.remat != "none":
+        one_layer = jax.checkpoint(one_layer, policy=remat_policy(c.remat))
+
+    def to_mb(a):  # [B, ...] -> [num_mb, B/num_mb, ...]
+        return a.reshape((num_mb, B // num_mb) + a.shape[1:])
+
+    # Activations cross the shard_map boundary in f32: the transpose rule for a
+    # replicated (P()) input inserts a psum of its cotangent, and XLA-CPU's
+    # AllReducePromotion pass crashes cloning that all-reduce in bf16 (its body
+    # carries an sdy.sharding_constraint). f32 at the boundary sidesteps the
+    # pass entirely; compute inside stays in compute_dtype.
+    compute_dtype = x.dtype
+    x_mbs = to_mb(x.astype(jnp.float32))
+    # a batch-independent [1,1,T,T] bias (no-padding case) is shared by every
+    # microbatch rather than materialized B times
+    shared_mask = mask_bias.shape[0] == 1
+    mask_mbs = mask_bias if shared_mask else to_mb(mask_bias)
+    pos_mbs = to_mb(positions)
+    kv_mbs = to_mb(kv_valid) if kv_valid is not None else None
+
+    def pipelined(stack_local, x_mbs, mask_mbs, pos_mbs, kv_mbs):
+        s = jax.lax.axis_index(PIPE_AXIS)
+        ticks = num_mb + stages - 1
+        perm = [(i, (i + 1) % stages) for i in range(stages)]
+
+        def stage_fn(h, mb_idx):
+            mask_mb = (
+                mask_mbs
+                if shared_mask
+                else jax.lax.dynamic_index_in_dim(mask_mbs, mb_idx, 0, keepdims=False)
+            )
+            pos_mb = jax.lax.dynamic_index_in_dim(pos_mbs, mb_idx, 0, keepdims=False)
+            kv_mb = (
+                jax.lax.dynamic_index_in_dim(kv_mbs, mb_idx, 0, keepdims=False)
+                if kv_mbs is not None
+                else None
+            )
+
+            def body(hh, layer_p):
+                return one_layer(hh, layer_p, mask_mb, pos_mb, kv_mb), None
+
+            h, _ = jax.lax.scan(body, h, stack_local)
+            return h
+
+        def tick(carry, t):
+            h, outs = carry
+            # the microbatch this stage works on at tick t (clipped in warmup/drain)
+            mb_idx = jnp.clip(t - s, 0, num_mb - 1)
+            inject = jax.lax.dynamic_index_in_dim(x_mbs, mb_idx, 0, keepdims=False)
+            h = jnp.where(s == 0, inject.astype(compute_dtype), h)
+            h = stage_fn(h, mb_idx)
+            # last stage's tick-t output is microbatch t-(stages-1)'s final activation
+            out_idx = t - (stages - 1)
+            write = jnp.logical_and(s == stages - 1, out_idx >= 0)
+            oi = jnp.clip(out_idx, 0, num_mb - 1)
+            cur = jax.lax.dynamic_index_in_dim(outs, oi, 0, keepdims=False)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(write, h.astype(jnp.float32), cur), oi, 0
+            )
+            h = jax.lax.ppermute(h, PIPE_AXIS, perm)
+            return (h, outs), None
+
+        init = (
+            jnp.zeros(x_mbs.shape[1:], compute_dtype),
+            jnp.zeros(x_mbs.shape, jnp.float32),
+        )
+        (_, outs), _ = jax.lax.scan(tick, init, jnp.arange(ticks))
+        # Replicate the result over pipe: only the last stage holds real outputs.
+        # (outs is f32 throughout — see boundary-dtype note above.)
+        outs = jax.lax.psum(
+            jnp.where(s == stages - 1, outs, jnp.zeros_like(outs)), PIPE_AXIS
+        )
+        return outs
+
+    P = PartitionSpec
+    stack_specs = jax.tree.map(lambda _: P(PIPE_AXIS), stack_params)
+    args = [stack_params, x_mbs, mask_mbs, pos_mbs]
+    in_specs = [stack_specs, P(), P(), P()]
+    if kv_mbs is not None:
+        args.append(kv_mbs)
+        in_specs.append(P())
+        fn = pipelined
+    else:
+        fn = lambda sl, xm, mm, pm: pipelined(sl, xm, mm, pm, None)
+    # check_vma=False: with varying-manual-axes tracking on, the initial scan
+    # carry needs a pcast-to-varying whose lowering (an all-reduce with a `copy`
+    # reduction) crashes XLA-CPU's AllReducePromotion pass in bf16. The manual
+    # psum above already guarantees the P() out_spec's replication.
+    out = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=P(),
+        axis_names={PIPE_AXIS},
+        check_vma=False,
+    )(*args)
+    return out.reshape((B,) + out.shape[2:]).astype(compute_dtype)

@@ -16,7 +16,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from trlx_tpu.parallel.mesh import FSDP_AXIS, MODEL_AXIS
+from trlx_tpu.parallel.mesh import FSDP_AXIS, MODEL_AXIS, PIPE_AXIS
 from trlx_tpu.utils import logging
 
 logger = logging.get_logger(__name__)
@@ -32,8 +32,23 @@ def default_lm_rules() -> List[Rule]:
     with FSDP on the other matmul dim; embeddings sharded on vocab over ``model``;
     norms and biases replicated (biases of row-parallel layers must be replicated since
     their outputs are psum-reduced).
+
+    Stacked-layer (pipeline-parallel) rules come first: when the model is built
+    with ``pipeline_stages > 1`` the block params live under ``layers_scan`` with
+    a leading ``[num_layers]`` dim, sharded over ``pipe`` so each stage holds only
+    its own layers; the remaining dims follow the same column/row TP + FSDP layout
+    shifted by one. Harmless when no ``layers_scan`` subtree exists.
     """
-    return [
+    stacked = [
+        (r".*layers_scan.*(q_proj|k_proj|v_proj|up_proj|gate_proj)/kernel$",
+         PartitionSpec(PIPE_AXIS, FSDP_AXIS, MODEL_AXIS)),
+        (r".*layers_scan.*(q_proj|k_proj|v_proj|up_proj|gate_proj)/bias$",
+         PartitionSpec(PIPE_AXIS, MODEL_AXIS)),
+        (r".*layers_scan.*(o_proj|down_proj)/kernel$",
+         PartitionSpec(PIPE_AXIS, MODEL_AXIS, FSDP_AXIS)),
+        (r".*layers_scan.*", PartitionSpec(PIPE_AXIS)),
+    ]
+    return stacked + [
         # embeddings: [vocab, hidden] — vocab over model (TP), hidden over fsdp
         (r".*embed_tokens/embedding$", PartitionSpec(MODEL_AXIS, FSDP_AXIS)),
         (r".*embed_positions/embedding$", PartitionSpec(None, FSDP_AXIS)),
@@ -53,8 +68,16 @@ def default_lm_rules() -> List[Rule]:
         (r".*/o/kernel$", PartitionSpec(MODEL_AXIS, FSDP_AXIS)),
         (r".*/(wi|wi_0|wi_1)/kernel$", PartitionSpec(FSDP_AXIS, MODEL_AXIS)),
         (r".*/wo/kernel$", PartitionSpec(MODEL_AXIS, FSDP_AXIS)),
-        # value / Q heads: small MLPs, shard hidden over fsdp only
-        (r".*(value_head|q_head|target_q_head|v_head).*/kernel$", PartitionSpec(FSDP_AXIS, None)),
+        # value / Q heads: Megatron column->row parallel over the model axis (the
+        # reference's ParallelLinear heads, modeling_nemo_ppo.py:95-130). FSDP on
+        # dim 0 would conflict with the batch-sharded activation and trigger XLA
+        # involuntary-remat resharding (observed in round-2 dryrun).
+        (r".*(value_head|q_head|target_q_head|v_head).*fc_in/kernel$",
+         PartitionSpec(None, MODEL_AXIS)),
+        (r".*(value_head|q_head|target_q_head|v_head).*fc_in/bias$",
+         PartitionSpec(MODEL_AXIS)),
+        (r".*(value_head|q_head|target_q_head|v_head).*fc_out/kernel$",
+         PartitionSpec(MODEL_AXIS, None)),
         # everything else (norms, biases, scalars): replicated
         (r".*", PartitionSpec()),
     ]
@@ -76,7 +99,8 @@ def _iter_paths(tree: Any, prefix: str = ""):
 
 
 def _clip_spec(spec: PartitionSpec, shape: Tuple[int, ...], mesh: Mesh) -> PartitionSpec:
-    """Drop named axes that don't divide the corresponding dim (or exceed rank)."""
+    """Drop named axes that don't divide the corresponding dim, exceed the rank,
+    or name an axis the mesh doesn't have (e.g. ``pipe`` on a custom 3-axis mesh)."""
     entries = list(spec)[: len(shape)]
     out = []
     for i, entry in enumerate(entries):
@@ -84,6 +108,9 @@ def _clip_spec(spec: PartitionSpec, shape: Tuple[int, ...], mesh: Mesh) -> Parti
             out.append(None)
             continue
         axes = entry if isinstance(entry, tuple) else (entry,)
+        if any(a not in mesh.shape for a in axes):
+            out.append(None)
+            continue
         size = int(np.prod([mesh.shape[a] for a in axes]))
         if shape[i] % size == 0:
             out.append(entry)

@@ -89,6 +89,9 @@ class ILQLTrainer(MeshRLTrainer):
 
     def setup_model(self):
         self.is_seq2seq = self.config.model.model_arch_type == "seq2seq"
+        # validates mesh.pipe combinations (incl. rejecting seq2seq) regardless
+        # of which arch branch runs below
+        pp_overrides = self.pipeline_overrides()
         overrides = dict(self.config.model.model_overrides or {})
         overrides.setdefault("param_dtype", self.param_dtype)
         overrides.setdefault("compute_dtype", self.compute_dtype)
@@ -100,9 +103,11 @@ class ILQLTrainer(MeshRLTrainer):
         from trlx_tpu.models.hf_loading import merge_loaded_params, peft_overrides
 
         overrides.update(peft_overrides(self.config.model.peft_config))
+        overrides.update(pp_overrides)
         self.model_config, trunk_params, self.model_type = load_pretrained(
             self.config.model.model_path, overrides
         )
+        trunk_params = self.maybe_stack_loaded(trunk_params, self.model_config.num_layers)
         self.module = CausalLMWithILQLHeads(self.model_config, two_qs=self.config.method.two_qs)
         self.trunk_module = TransformerLM(self.model_config)
 

@@ -96,6 +96,48 @@ class MeshRLTrainer(BaseRLTrainer):
         self.model_config, self.model_type."""
         ...
 
+    def pipeline_overrides(self) -> Dict[str, Any]:
+        """Model overrides enabling pipeline parallelism when ``mesh.pipe > 1``
+        (stacked layer layout + GPipe schedule, trlx_tpu/parallel/pipeline.py).
+        Validates the config combinations PP cannot serve: stacked layers have no
+        per-layer param paths, so partial layer freezing and the hydra/value
+        branches (which capture a mid-stack activation) are unavailable — PPO
+        falls back to the full reference copy it already uses at
+        ``num_layers_unfrozen=-1`` (the NeMo PP reference does the same,
+        modeling_nemo_ppo.py:228-244)."""
+        mc = self.config.mesh
+        if mc.pipe <= 1:
+            return {}
+        if self.config.model.model_arch_type == "seq2seq":
+            raise ValueError("pipeline parallelism (mesh.pipe > 1) is causal-LM only")
+        if self.config.model.num_layers_unfrozen >= 0:
+            raise ValueError(
+                "mesh.pipe > 1 requires num_layers_unfrozen=-1: pipelined models "
+                "keep block params stacked and cannot freeze or branch at a layer "
+                "boundary (PPO then uses a full reference copy automatically)"
+            )
+        if getattr(self.config.method, "num_value_layers_unfrozen", 0):
+            raise ValueError("mesh.pipe > 1 requires num_value_layers_unfrozen=0")
+        overrides: Dict[str, Any] = {
+            "pipeline_stages": mc.pipe,
+            "pipeline_microbatches": mc.pipeline_microbatches,
+        }
+        if mc.sequence_shard:
+            logger.warning(
+                "mesh.sequence_shard is disabled under pipeline parallelism: "
+                "the pipelined stack applies no sequence-sharding constraints"
+            )
+            overrides["sequence_sharding"] = False
+        return overrides
+
+    def maybe_stack_loaded(self, trunk_params, num_layers: int):
+        """Convert HF-loaded per-layer params to the stacked layout under PP."""
+        if self.config.mesh.pipe > 1 and trunk_params is not None:
+            from trlx_tpu.parallel.pipeline import stack_layer_params
+
+            return stack_layer_params(trunk_params, num_layers)
+        return trunk_params
+
     def trainable_path_predicate(self, path: str) -> bool:
         """Which params receive gradients (parity: ``freeze_bottom_causal_layers``,
         reference utils/modeling.py:22-45): with num_layers_unfrozen = N > 0, only
@@ -111,6 +153,14 @@ class MeshRLTrainer(BaseRLTrainer):
             return True
         if "transformer" not in path:
             return True  # heads always train
+        if "layers_scan" in path:
+            # stacked blocks have no per-layer paths; partial freezing cannot be
+            # honored. Reachable only when pipeline_stages was forced through
+            # model_overrides (mesh.pipe > 1 validates this earlier).
+            raise ValueError(
+                "num_layers_unfrozen >= 0 cannot be applied to a stacked "
+                "(pipeline_stages > 1) model; set num_layers_unfrozen=-1"
+            )
         if "layers_" in path:
             layer = int(path.split("layers_")[1].split("/")[0])
             return layer >= self.model_config.num_layers - n_unfrozen
@@ -558,6 +608,11 @@ class MeshRLTrainer(BaseRLTrainer):
         params = jax.device_get(self.params)
         trunk_key = "transformer" if "transformer" in params else ("t5" if "t5" in params else None)
         trunk = params[trunk_key] if trunk_key else params
+        if getattr(self.model_config, "pipeline_stages", 1) > 1 and "layers_scan" in trunk:
+            # HF layout is per-layer: unstack the pipeline layout before export
+            from trlx_tpu.parallel.pipeline import unstack_layer_params
+
+            trunk = unstack_layer_params(trunk, self.model_config.num_layers)
         if getattr(self.model_config, "lora_r", 0):
             from trlx_tpu.models.transformer import merge_lora_params
 

@@ -42,9 +42,11 @@ class SFTTrainer(MeshRLTrainer):
         from trlx_tpu.models.hf_loading import init_params, merge_loaded_params, peft_overrides
 
         overrides.update(peft_overrides(self.config.model.peft_config))
+        overrides.update(self.pipeline_overrides())
         self.model_config, trunk_params, self.model_type = load_pretrained(
             self.config.model.model_path, overrides
         )
+        trunk_params = self.maybe_stack_loaded(trunk_params, self.model_config.num_layers)
         self.trunk_module = TransformerLM(self.model_config)
         init_tree = init_params(self.model_config, self.trunk_module, self.config.train.seed)
         if trunk_params is not None:
