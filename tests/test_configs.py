@@ -63,3 +63,43 @@ def test_evolve():
     assert new.method.ppo_epochs == 2
     # original untouched
     assert config.train.batch_size == 32
+
+
+def test_update_open_dict_fields_accept_new_keys():
+    """Dotted paths may introduce NEW keys inside free-form dict fields
+    (model_overrides / kwargs / gen_kwargs / peft_config), while typed levels
+    keep strict typo detection."""
+    config = default_ppo_config()
+    config.model.model_overrides = {"hidden_size": 32}
+    new = TRLConfig.update(
+        config.to_dict(),
+        {
+            "model.model_overrides.scan_layers": True,
+            "optimizer.kwargs.weight_decay": 0.1,
+            "method.gen_kwargs.max_new_tokens": 5,
+        },
+    )
+    assert new.model.model_overrides == {"hidden_size": 32, "scan_layers": True}
+    assert new.optimizer.kwargs["weight_decay"] == 0.1
+    assert new.method.gen_kwargs["max_new_tokens"] == 5
+
+    # a None-valued open field accepts a dotted subtree wholesale
+    new2 = TRLConfig.update(
+        default_ppo_config().to_dict(),
+        {"model.peft_config.peft_type": "LORA", "model.peft_config.r": 4},
+    )
+    assert new2.model.peft_config == {"peft_type": "LORA", "r": 4}
+
+    with pytest.raises(ValueError):
+        TRLConfig.update(config.to_dict(), {"model.nm_layers_unfrozen": 2})
+
+
+def test_update_rejects_descent_through_scalar_fields():
+    """A dotted path that descends THROUGH a scalar typed field must raise, not
+    silently turn the scalar into a dict (regression guard for the open-dict
+    merge)."""
+    config = default_ppo_config()
+    with pytest.raises(ValueError):
+        TRLConfig.update(config.to_dict(), {"train.seed.value": 5})
+    with pytest.raises(ValueError):
+        TRLConfig.update(config.to_dict(), {"model.model_path.foo": "x"})

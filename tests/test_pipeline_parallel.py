@@ -166,6 +166,44 @@ def test_pipelined_bf16_forward_compiles(setup):
     assert bool(jnp.all(jnp.isfinite(logits.astype(jnp.float32))))
 
 
+def test_scan_layers_matches_listed(setup):
+    """scan_layers=True uses the stacked layout + lax.scan WITHOUT pipelining
+    (O(1)-in-depth compile); numerics must match the listed model, including
+    cached decode."""
+    ids, mask, m_list, p_list, logits_ref, _, p_stack = setup
+    m_scan = TransformerLM(CFG.replace(scan_layers=True))
+    logits, _, _, _ = m_scan.apply({"params": p_stack}, ids, mask)
+    assert float(jnp.max(jnp.abs(logits - logits_ref))) < 1e-5
+
+    S = T + 1
+    cache_l = m_list.init_cache(B, S)
+    cache_s = m_scan.init_cache(B, S)
+    full = jnp.pad(mask, ((0, 0), (0, 1)))
+    lg_l, _, _, _ = m_list.apply({"params": p_list}, ids, full, cache=cache_l)
+    lg_s, _, _, _ = m_scan.apply({"params": p_stack}, ids, full, cache=cache_s)
+    np.testing.assert_allclose(np.asarray(lg_l), np.asarray(lg_s), atol=1e-5)
+
+
+@pytest.mark.slow
+def test_sft_trains_with_scan_layers(tmp_path):
+    """End-to-end SFT with scan_layers through model_overrides (no pipe axis)."""
+    import trlx_tpu
+    from trlx_tpu.methods.sft import SFTConfig
+
+    config = _trl_config(tmp_path, "SFTTrainer", SFTConfig(gen_kwargs=dict(max_new_tokens=4)))
+    config.mesh.pipe = 1
+    config.mesh.model = 2
+    config.mesh.fsdp = 2
+    config.model.model_overrides["scan_layers"] = True
+    trainer = trlx_tpu.train(
+        samples=["ab ab abab", "cd cdcd", "efgh ef", "a b a b"] * 2,
+        eval_prompts=["ab", "cd"],
+        config=config,
+    )
+    assert trainer.iter_count >= 3
+    assert "layers_scan" in trainer.params["transformer"]
+
+
 def test_pick_microbatches():
     assert pick_microbatches(8, 4) == 4
     assert pick_microbatches(6, 4) == 3

@@ -16,16 +16,50 @@ import yaml
 from trlx_tpu.data.method_configs import MethodConfig, get_method
 
 
-def merge(base: Dict, update: Dict, updated: Set[str], prefix: str = "") -> Dict:
-    """Recursively merge ``update`` into ``base``, recording consumed dotted leaf paths."""
+# Free-form dict fields: dotted-path updates may introduce NEW keys below these
+# (e.g. "model.model_overrides.scan_layers", "optimizer.kwargs.weight_decay").
+# Typed config levels keep strict typo detection.
+OPEN_DICT_FIELDS = {
+    "model_overrides",
+    "kwargs",
+    "gen_kwargs",
+    "gen_experience_kwargs",
+    "trainer_kwargs",
+    "peft_config",
+}
+
+
+def _mark_leaves(v: Any, path: str, updated: Set[str]) -> None:
+    if isinstance(v, dict) and v:
+        updated.update(_leaf_paths(v, path))
+    else:
+        updated.add(path)
+
+
+def merge(base: Dict, update: Dict, updated: Set[str], prefix: str = "", open_dict: bool = False) -> Dict:
+    """Recursively merge ``update`` into ``base``, recording consumed dotted leaf
+    paths. Inside free-form dict fields (``OPEN_DICT_FIELDS``) new keys are
+    accepted; elsewhere unknown keys stay unconsumed so the caller can flag them."""
     for k, v in base.items():
         path = f"{prefix}.{k}" if prefix else str(k)
         if k in update:
             if isinstance(v, dict) and isinstance(update[k], dict):
-                base[k] = merge(v, update[k], updated, path)
+                base[k] = merge(
+                    v, update[k], updated, path, open_dict or k in OPEN_DICT_FIELDS
+                )
+            elif isinstance(update[k], dict) and not (open_dict or k in OPEN_DICT_FIELDS):
+                # dotted path descending THROUGH a scalar typed field (e.g.
+                # "train.seed.value") — leave unconsumed so the caller flags it
+                continue
             else:
                 base[k] = update[k]
-                updated.add(path)
+                _mark_leaves(update[k], path, updated)
+    if open_dict:
+        for k, v in update.items():
+            if k not in base:
+                path = f"{prefix}.{k}" if prefix else str(k)
+                base[k] = v
+                _mark_leaves(v, path, updated)
     return base
 
 
@@ -168,6 +202,10 @@ class MeshConfig:
     pipe: int = 1
     model: int = 1
     pipeline_microbatches: int = 4
+    # Persistent XLA compilation cache directory (also settable via the
+    # TRLX_COMPILE_CACHE env var). First TPU compiles are 20-40s; subsequent
+    # runs with the same shapes restore from here in milliseconds.
+    compilation_cache_dir: Optional[str] = None
     remat: str = "none"
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"

@@ -91,6 +91,17 @@ class TransformerConfig:
     # scheduling off for inference too, modeling_nemo_ppo.py:838-870).
     pipeline_stages: int = 1
     pipeline_microbatches: int = 4
+    # Stacked-layer layout WITHOUT pipelining: params [L, ...] under
+    # "layers_scan", forwards run lax.scan over layers. Compile time becomes
+    # O(1) in depth (an unrolled 32-layer llama body is traced/compiled 32x;
+    # the scanned body once) at the cost of per-layer freeze paths and hydra
+    # branches (same restrictions as pipeline_stages > 1).
+    scan_layers: bool = False
+
+    @property
+    def stacked(self) -> bool:
+        """Whether block params use the stacked [num_layers, ...] layout."""
+        return self.pipeline_stages > 1 or self.scan_layers
     # Megatron-SP analogue: shard the residual stream's sequence dim over the
     # `model` axis between blocks (reference sequence_parallel cfg,
     # modeling_nemo_ppo.py:160-164). Applied on cache-free forwards.
@@ -491,24 +502,25 @@ class TransformerLM(nn.Module):
         block = Block
         if c.remat != "none":
             block = nn.remat(Block, policy=remat_policy(c.remat))
-        if c.pipeline_stages > 1:
-            if c.num_layers % c.pipeline_stages != 0:
-                raise ValueError(
-                    f"num_layers={c.num_layers} not divisible by "
-                    f"pipeline_stages={c.pipeline_stages}"
-                )
-            if c.attention_impl == "ring":
-                raise ValueError(
-                    "pipeline_stages > 1 cannot nest ring attention's shard_map; "
-                    "use attention_impl='xla' or 'flash'"
-                )
-            if c.sequence_sharding:
-                raise ValueError(
-                    "pipeline_stages > 1 does not apply sequence-sharding "
-                    "constraints inside the pipelined stack; set "
-                    "sequence_sharding=False (the trainer does this automatically "
-                    "when mesh.pipe > 1)"
-                )
+        if c.stacked:
+            if c.pipeline_stages > 1:
+                if c.num_layers % c.pipeline_stages != 0:
+                    raise ValueError(
+                        f"num_layers={c.num_layers} not divisible by "
+                        f"pipeline_stages={c.pipeline_stages}"
+                    )
+                if c.attention_impl == "ring":
+                    raise ValueError(
+                        "pipeline_stages > 1 cannot nest ring attention's shard_map; "
+                        "use attention_impl='xla' or 'flash'"
+                    )
+                if c.sequence_sharding:
+                    raise ValueError(
+                        "pipeline_stages > 1 does not apply sequence-sharding "
+                        "constraints inside the pipelined stack; set "
+                        "sequence_sharding=False (the trainer does this automatically "
+                        "when mesh.pipe > 1)"
+                    )
             # stacked layout: one scanned Block whose params carry a leading
             # [num_layers] dim (sharded over "pipe" by the partition rules)
             self.layers_scan = nn.scan(
@@ -652,7 +664,7 @@ class TransformerLM(nn.Module):
             x = constrain_seq(x)
         captures = {}
         branch_hidden = None
-        if c.pipeline_stages > 1:
+        if c.stacked:
             if capture_set:
                 raise NotImplementedError(
                     "stacked/pipelined models do not support hydra branch capture "
@@ -702,7 +714,7 @@ class TransformerLM(nn.Module):
         return logits, hidden, branch_out, new_cache
 
     def _apply_stacked(self, x, mask_bias, positions, cache, kv_valid):
-        """Run the stacked block stack (``pipeline_stages > 1`` layout).
+        """Run the stacked block stack (``pipeline_stages > 1`` or ``scan_layers`` layout).
 
         Cached decode → sequential ``nn.scan`` over the stacked params (each
         layer's shard is streamed to where it's needed; the NeMo reference
@@ -741,9 +753,9 @@ class TransformerLM(nn.Module):
         This is the hydra frozen-branch forward (reference ``forward_hydra``,
         modeling_ppo.py:410-453) — called with the frozen param subtree via
         ``apply({"params": frozen}, ..., method="forward_from")``."""
-        if self.config.pipeline_stages > 1:
+        if self.config.stacked:
             raise NotImplementedError(
-                "hydra branch forwards need per-layer params; pipelined models "
+                "hydra branch forwards need per-layer params; stacked models "
                 "use a separate reference model (num_layers_unfrozen=-1)"
             )
         B, T, _ = hidden.shape
