@@ -98,6 +98,37 @@ def test_sequence_sharding_constraint_in_hlo_and_numerics(mesh8):
     )
 
 
+def test_sequence_sharding_applies_inside_scanned_stack(mesh8):
+    """scan_layers composes with sequence_sharding: the per-layer residual
+    constraint lives in Block itself, so the nn.scan path carries it too
+    (round-2 review: the scan path silently dropped SP)."""
+    from trlx_tpu.models.presets import PRESETS
+    from trlx_tpu.models.transformer import TransformerLM
+
+    base = PRESETS["gpt2"].replace(
+        vocab_size=32, hidden_size=16, num_layers=2, num_heads=2,
+        max_position_embeddings=64, compute_dtype=jnp.float32,
+        scan_layers=True, sequence_sharding=True,
+    )
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (8, 16), 1, 32)
+    mask = jnp.ones((8, 16), jnp.int32)
+    model = TransformerLM(base)
+    params = model.init(rng, ids, mask)["params"]
+    fn = lambda p, i, m: model.apply({"params": p}, i, m)[0]
+    with mesh8:
+        lowered = jax.jit(fn).lower(params, ids, mask).as_text()
+        logits = jax.jit(fn)(params, ids, mask)
+    # the constraint must appear inside the scanned body (a while/scan region)
+    assert "Sharding" in lowered or "sharding_constraint" in lowered
+    assert "model" in lowered
+    ref = TransformerLM(base.replace(sequence_sharding=False))
+    logits_ref = ref.apply({"params": params}, ids, mask)[0]
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(logits_ref), atol=2e-4, rtol=1e-4
+    )
+
+
 def test_long_seq_sp_ring_reduces_per_chip_memory():
     """SP activations + ring attention cut per-chip temp memory for long
     sequences (~S/n activation residency; measured 34.2MB -> 0.9MB at S=1024 on

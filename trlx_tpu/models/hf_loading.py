@@ -523,12 +523,23 @@ def init_params(config: TransformerConfig, module=None, seed: int = 0) -> Dict[s
 def load_pretrained(
     model_path: str,
     overrides: Optional[Dict[str, Any]] = None,
+    mesh=None,
 ) -> Tuple[TransformerConfig, Optional[Dict[str, Any]], str]:
     """Resolve (config, trunk params or None, model_type) for a model path.
 
     Local dir with config.json + weights → converted checkpoint. Otherwise a family
     preset with no params (caller random-inits) — the zero-egress fallback.
+    With ``mesh``, a native pre-converted checkpoint restores directly into device
+    shards (per-host partial reads); torch checkpoints always load host-side.
     """
+    from trlx_tpu import checkpointing
+
+    if checkpointing.is_native_checkpoint(model_path):
+        # pre-converted chunked store: already in TransformerLM layout, restores
+        # with per-host partial reads (see trlx_tpu/checkpointing.py)
+        return checkpointing.restore_native(
+            model_path, overrides, mesh=mesh, expect_seq2seq=False
+        )
     config_path = os.path.join(model_path, "config.json")
     if os.path.isdir(model_path) and os.path.exists(config_path):
         import transformers
@@ -747,10 +758,18 @@ def _t5_from_params(p: Dict[str, Any], c) -> Dict[str, np.ndarray]:
 CONVERTERS["t5"] = (t5_state_dict_to_params, _t5_from_params)
 
 
-def load_pretrained_seq2seq(model_path: str, overrides: Optional[Dict[str, Any]] = None):
+def load_pretrained_seq2seq(
+    model_path: str, overrides: Optional[Dict[str, Any]] = None, mesh=None
+):
     """Resolve (T5Config, params or None) for a seq2seq model path."""
+    from trlx_tpu import checkpointing
     from trlx_tpu.models.t5 import T5Config, from_hf_t5_config
 
+    if checkpointing.is_native_checkpoint(model_path):
+        config, params, _ = checkpointing.restore_native(
+            model_path, overrides, mesh=mesh, expect_seq2seq=True
+        )
+        return config, params
     config_path = os.path.join(model_path, "config.json")
     if os.path.isdir(model_path) and os.path.exists(config_path):
         import transformers

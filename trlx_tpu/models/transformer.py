@@ -463,12 +463,20 @@ class Block(nn.Module):
             h2 = h1 if c.shared_parallel_ln else _norm_module(c, "ln_2")(x)
             attn_out, new_cache = Attention(c, name="attn")(h1, mask_bias, positions, cache, kv_valid)
             mlp_out = MLP(c, name="mlp")(h2)
-            return x + attn_out + mlp_out, new_cache
+            out = x + attn_out + mlp_out
+            if c.sequence_sharding and cache is None:
+                out = constrain_seq(out)
+            return out, new_cache
         attn_out, new_cache = Attention(c, name="attn")(
             _norm_module(c, "ln_1")(x), mask_bias, positions, cache, kv_valid
         )
         x = x + attn_out
         x = x + MLP(c, name="mlp")(_norm_module(c, "ln_2")(x))
+        # per-layer Megatron-SP residual constraint lives HERE (not in the caller's
+        # layer loop) so every path — listed loop, nn.scan stack, value branch,
+        # forward_from — gets it identically
+        if c.sequence_sharding and cache is None:
+            x = constrain_seq(x)
         return x, new_cache
 
 
@@ -682,8 +690,6 @@ class TransformerLM(nn.Module):
                 if cache is not None:
                     layer_cache = {"k": cache["k"][i], "v": cache["v"][i], "index": cache["index"]}
                 x, new_lc = layer(x, mask_bias, layer_positions, layer_cache, kv_valid)
-                if seq_shard:
-                    x = constrain_seq(x)
                 if cache is not None:
                     new_layer_caches.append(new_lc)
             stacked_kv = None
@@ -765,8 +771,6 @@ class TransformerLM(nn.Module):
         x = hidden
         for layer in self.layers[start_layer:]:
             x, _ = layer(x, mask_bias, positions, None, attention_mask)
-            if self.config.sequence_sharding:
-                x = constrain_seq(x)
         logits, _ = self._final(x)
         return logits
 

@@ -127,8 +127,9 @@ def make_param_specs(params: Any, mesh: Mesh, rules: Optional[Sequence[Rule]] = 
         if isinstance(tree, dict):
             return {k: build(v, f"{prefix}/{k}" if prefix else str(k)) for k, v in tree.items()}
         spec = spec_for_path(prefix, rules)
-        shape = np.shape(tree)
-        return _clip_spec(spec, shape, mesh)
+        # .shape covers abstract leaves too (ShapeDtypeStruct, orbax metadata)
+        shape = tree.shape if hasattr(tree, "shape") else np.shape(tree)
+        return _clip_spec(spec, tuple(shape), mesh)
 
     return build(params)
 

@@ -105,7 +105,7 @@ class ILQLTrainer(MeshRLTrainer):
         overrides.update(peft_overrides(self.config.model.peft_config))
         overrides.update(pp_overrides)
         self.model_config, trunk_params, self.model_type = load_pretrained(
-            self.config.model.model_path, overrides
+            self.config.model.model_path, overrides, mesh=self.restore_mesh(overrides)
         )
         trunk_params = self.maybe_stack_loaded(trunk_params, self.model_config.num_layers)
         self.module = CausalLMWithILQLHeads(self.model_config, two_qs=self.config.method.two_qs)
@@ -131,7 +131,7 @@ class ILQLTrainer(MeshRLTrainer):
         from trlx_tpu.models.policy import Seq2SeqLMWithILQLHeads
 
         self.model_config, t5_params = load_pretrained_seq2seq(
-            self.config.model.model_path, overrides
+            self.config.model.model_path, overrides, mesh=self.mesh
         )
         self.model_type = "t5"
         self.decoder_start_token_id = self.model_config.decoder_start_token_id

@@ -138,6 +138,15 @@ class MeshRLTrainer(BaseRLTrainer):
             overrides["sequence_sharding"] = False
         return overrides
 
+    def restore_mesh(self, overrides: Dict[str, Any]):
+        """Mesh to hand ``load_pretrained`` for direct-to-device sharded restore
+        of native checkpoints — or None when the model will use the stacked
+        layout, whose host-side [L, ...] restack (``maybe_stack_loaded``) needs
+        host arrays (np.asarray on non-addressable shards would throw on pods)."""
+        if overrides.get("scan_layers") or overrides.get("pipeline_stages", 1) > 1:
+            return None
+        return self.mesh
+
     def maybe_stack_loaded(self, trunk_params, num_layers: int, stacked: Optional[bool] = None):
         """Convert HF-loaded per-layer params to the stacked layout when the
         built model uses it (``mesh.pipe > 1`` or ``scan_layers``)."""

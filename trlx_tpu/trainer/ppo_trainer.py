@@ -84,7 +84,7 @@ class PPOTrainer(MeshRLTrainer):
         overrides.update(peft_overrides(self.config.model.peft_config))
         overrides.update(pp_overrides)
         self.model_config, trunk_params, self.model_type = load_pretrained(
-            self.config.model.model_path, overrides
+            self.config.model.model_path, overrides, mesh=self.restore_mesh(overrides)
         )
         trunk_params = self.maybe_stack_loaded(trunk_params, self.model_config.num_layers)
         self.module = CausalLMWithValueHead(
@@ -158,7 +158,7 @@ class PPOTrainer(MeshRLTrainer):
             )
 
         self.model_config, t5_params = load_pretrained_seq2seq(
-            self.config.model.model_path, overrides
+            self.config.model.model_path, overrides, mesh=self.mesh
         )
         self.model_type = "t5"
         self.peft_base_ref = False

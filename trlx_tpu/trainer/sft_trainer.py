@@ -44,7 +44,7 @@ class SFTTrainer(MeshRLTrainer):
         overrides.update(peft_overrides(self.config.model.peft_config))
         overrides.update(self.pipeline_overrides())
         self.model_config, trunk_params, self.model_type = load_pretrained(
-            self.config.model.model_path, overrides
+            self.config.model.model_path, overrides, mesh=self.restore_mesh(overrides)
         )
         trunk_params = self.maybe_stack_loaded(trunk_params, self.model_config.num_layers)
         self.trunk_module = TransformerLM(self.model_config)
