@@ -22,6 +22,7 @@ import json
 import os
 import sys
 import time
+from functools import partial
 
 REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO_ROOT)
@@ -144,7 +145,9 @@ def _gpt2_perf_impl(jax, impl):
         loss, _ = method.loss(logprobs, values_pred, old_lp, old_v, adv, ret, r_mask)
         return loss
 
-    @jax.jit
+    # donate params/opt state like the real trainer's train_step does — without
+    # donation XLA copies the full param tree every step
+    @partial(jax.jit, donate_argnums=(0, 1))
     def train_step(p, s):
         grads = jax.grad(loss_fn)(p)
         updates, s2 = tx.update(grads, s, p)

@@ -147,28 +147,33 @@ class T5Attention(nn.Module):
         c = self.config
         B, T, _ = x.shape
         q = self.q(x).reshape(B, T, c.num_heads, c.d_kv)
+        # kh/vh [B, H, S, D] — the cache layout (contiguous per-(b,h) along S,
+        # see TransformerLM.Attention: avoids a full-cache transposed copy per
+        # decode step)
         if kv_static is not None:
-            k, v = kv_static
+            kh, vh = kv_static  # already [B, H, S, D] (cross_kv)
             new_cache = None
         else:
             src = x if kv is None else kv
             S = src.shape[1]
             k = self.k(src).reshape(B, S, c.num_heads, c.d_kv)
             v = self.v(src).reshape(B, S, c.num_heads, c.d_kv)
+            kh = k.transpose(0, 2, 1, 3)
+            vh = v.transpose(0, 2, 1, 3)
             if cache is not None:
                 idx = cache["index"]
-                k = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, idx, 0, 0))
-                v = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, idx, 0, 0))
-                new_cache = {"k": k, "v": v}
+                kh = jax.lax.dynamic_update_slice(cache["k"], kh.astype(cache["k"].dtype), (0, 0, idx, 0))
+                vh = jax.lax.dynamic_update_slice(cache["v"], vh.astype(cache["v"].dtype), (0, 0, idx, 0))
+                new_cache = {"k": kh, "v": vh}
             else:
                 new_cache = None
-        scores = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
+        scores = jnp.einsum("bthd,bhsd->bhts", q, kh).astype(jnp.float32)
         if position_bias is not None:
             scores = scores + position_bias.astype(jnp.float32)
         if mask_bias is not None:
             scores = scores + mask_bias
         probs = jax.nn.softmax(scores, axis=-1).astype(c.compute_dtype)
-        out = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(B, T, c.num_heads * c.d_kv)
+        out = jnp.einsum("bhts,bhsd->bthd", probs, vh).reshape(B, T, c.num_heads * c.d_kv)
         return self.o(out), new_cache
 
 
@@ -228,12 +233,13 @@ class T5DecoderBlock(nn.Module):
         return x, new_cache
 
     def cross_kv(self, enc_states):
-        """Precompute cross-attention K/V from encoder states (prefill)."""
+        """Precompute cross-attention K/V from encoder states (prefill).
+        Returned in the [B, H, S, D] attention layout."""
         c = self.config
         B, S, _ = enc_states.shape
         k = self.cross_attn.k(enc_states).reshape(B, S, c.num_heads, c.d_kv)
         v = self.cross_attn.v(enc_states).reshape(B, S, c.num_heads, c.d_kv)
-        return k, v
+        return k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
 
 
 class T5LM(nn.Module):
@@ -293,9 +299,11 @@ class T5LM(nn.Module):
         hidden = self.decoder_ln(x)
         new_cache = None
         if cache is not None:
+            # per-layer list layout (see TransformerLM.init_cache): restacking
+            # would copy the whole cache every decode step
             new_cache = {
-                "k": jnp.stack([lc["k"] for lc in new_caches]),
-                "v": jnp.stack([lc["v"] for lc in new_caches]),
+                "k": [lc["k"] for lc in new_caches],
+                "v": [lc["v"] for lc in new_caches],
                 "index": cache["index"] + x.shape[1],
             }
         return hidden, new_cache, branch_hidden
@@ -338,7 +346,7 @@ class T5LM(nn.Module):
         x = self.shared(decoder_input_ids)
 
         if cache is not None:
-            S = cache["k"].shape[2]
+            S = cache["k"][0].shape[2]  # per-layer [B,H,S,D]
             idx = cache["index"]
             if positions is None:
                 positions = idx + jnp.arange(T, dtype=jnp.int32)
@@ -430,15 +438,23 @@ class T5LM(nn.Module):
         return self._head(self.decoder_ln(x))
 
     def precompute_cross_kv(self, enc_states):
+        # per-layer lists (not stacked arrays): slicing layer i from a stacked
+        # [L, ...] array inside the decode loop copies the whole thing per step
         ks, vs = [], []
         for block in self.decoder_blocks:
             k, v = block.cross_kv(enc_states)
             ks.append(k)
             vs.append(v)
-        return jnp.stack(ks), jnp.stack(vs)
+        return ks, vs
 
     def init_cache(self, batch_size: int, max_length: int, dtype=None) -> Dict[str, Any]:
         c = self.config
         dtype = dtype or c.compute_dtype
-        shape = (c.num_decoder_layers, batch_size, max_length, c.num_heads, c.d_kv)
-        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype), "index": jnp.array(0, jnp.int32)}
+        # per-layer list layout: in-place single-token writes in the decode loop
+        # (a stacked [L, ...] array forces full-cache slice/restack copies per step)
+        shape = (batch_size, c.num_heads, max_length, c.d_kv)
+        return {
+            "k": [jnp.zeros(shape, dtype) for _ in range(c.num_decoder_layers)],
+            "v": [jnp.zeros(shape, dtype) for _ in range(c.num_decoder_layers)],
+            "index": jnp.array(0, jnp.int32),
+        }
