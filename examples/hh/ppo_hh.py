@@ -16,7 +16,6 @@ sys.path.insert(0, ".")
 
 from typing import List
 
-import numpy as np
 
 import trlx_tpu
 from examples.sentiment_task import TINY_MODEL_OVERRIDES, lexicon_sentiment

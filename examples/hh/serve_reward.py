@@ -16,12 +16,17 @@ Then: TRLX_REWARD_URL=http://localhost:8500/v2/models/reward/infer \
 
 import argparse
 import json
+import os
 import sys
 from http.server import BaseHTTPRequestHandler, HTTPServer
 
 sys.path.insert(0, ".")
 
 from examples.sentiment_task import lexicon_sentiment  # noqa: E402
+
+# Scoring backend: lexicon by default; a real local sequence-classification
+# checkpoint when --model-dir (or TRLX_REWARD_MODEL_DIR) points at one.
+SCORE_FN = lexicon_sentiment
 
 
 class RewardHandler(BaseHTTPRequestHandler):
@@ -33,14 +38,14 @@ class RewardHandler(BaseHTTPRequestHandler):
             #   "shape": [N], "data": [...strings...]}, ...]}
             tensors = {t["name"]: t["data"] for t in req.get("inputs", [])}
             outputs = tensors.get("outputs") or tensors.get("samples") or []
-            scores = lexicon_sentiment([str(s) for s in outputs])
+            scores = SCORE_FN([str(s) for s in outputs])
             chosen = tensors.get("chosen")
             if chosen:
                 if len(chosen) != len(scores):
                     raise ValueError(
                         f"length mismatch: {len(scores)} outputs vs {len(chosen)} chosen"
                     )
-                chosen_scores = lexicon_sentiment([str(s) for s in chosen])
+                chosen_scores = SCORE_FN([str(s) for s in chosen])
                 scores = [s - c for s, c in zip(scores, chosen_scores)]
             body = json.dumps(
                 {
@@ -65,9 +70,19 @@ class RewardHandler(BaseHTTPRequestHandler):
 
 
 def main():
+    global SCORE_FN
     parser = argparse.ArgumentParser()
     parser.add_argument("--port", type=int, default=8500)
+    parser.add_argument(
+        "--model-dir", default=os.environ.get("TRLX_REWARD_MODEL_DIR"),
+        help="local HF sequence-classification checkpoint to serve instead of the lexicon",
+    )
     args = parser.parse_args()
+    if args.model_dir:
+        from examples.sentiment_task import load_sentiment_scorer
+
+        SCORE_FN = load_sentiment_scorer(args.model_dir)
+        print(f"serving checkpoint {args.model_dir}", flush=True)
     server = HTTPServer(("127.0.0.1", args.port), RewardHandler)
     print(f"reward server listening on http://127.0.0.1:{args.port}/v2/models/reward/infer", flush=True)
     server.serve_forever()

@@ -1,0 +1,91 @@
+"""Train a tiny sequence-classification reward model and save it as a local HF
+checkpoint for `serve_reward.py --model-dir`.
+
+The reference's HH recipe trains a 6B preference reward model and serves it via
+Triton (`/root/reference/examples/hh/`). In the zero-egress sandbox this stands
+in for that stage: a DistilBERT-shaped classifier fitted (torch CPU) on the
+synthetic sentiment corpus, so the served reward is *learned* rather than a
+lexicon — exercising the full checkpoint -> server -> RPC client -> PPO chain.
+
+Usage: python examples/hh/train_tiny_rm.py [--out ckpts/tiny_rm] [--steps 300]
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+from examples.sentiment_task import NEGATIVE, POSITIVE, build_corpus, lexicon_sentiment
+
+
+def build_tokenizer(tmp_vocab_path):
+    from transformers import DistilBertTokenizer
+
+    words = sorted(set(POSITIVE + NEGATIVE + "really just so quite the a movie film and".split()))
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + words
+    with open(tmp_vocab_path, "w") as f:
+        f.write("\n".join(vocab))
+    return DistilBertTokenizer(tmp_vocab_path)
+
+
+def main():
+    import torch
+    from transformers import DistilBertConfig, DistilBertForSequenceClassification
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default="ckpts/tiny_rm")
+    parser.add_argument("--steps", type=int, default=300)
+    parser.add_argument("--batch-size", type=int, default=32)
+    args = parser.parse_args()
+
+    corpus = build_corpus(n=2000, seed=0)
+    labels = [1 if lexicon_sentiment([t])[0] > 0 else 0 for t in corpus]
+
+    import os
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        tok = build_tokenizer(os.path.join(td, "vocab.txt"))
+    cfg = DistilBertConfig(
+        vocab_size=tok.vocab_size, dim=64, n_layers=2, n_heads=2, hidden_dim=128,
+        max_position_embeddings=64, num_labels=2,
+        id2label={0: "NEGATIVE", 1: "POSITIVE"}, label2id={"NEGATIVE": 0, "POSITIVE": 1},
+    )
+    torch.manual_seed(0)
+    model = DistilBertForSequenceClassification(cfg)
+    opt = torch.optim.AdamW(model.parameters(), lr=5e-4)
+    rng = np.random.default_rng(0)
+
+    model.train()
+    for step in range(args.steps):
+        idx = rng.integers(len(corpus), size=args.batch_size)
+        enc = tok([corpus[i] for i in idx], return_tensors="pt", padding=True,
+                  truncation=True, max_length=48)
+        y = torch.tensor([labels[i] for i in idx])
+        out = model(**enc, labels=y)
+        opt.zero_grad()
+        out.loss.backward()
+        opt.step()
+        if step % 50 == 0:
+            acc = (out.logits.argmax(-1) == y).float().mean().item()
+            print(f"[rm] step {step} loss {out.loss.item():.4f} acc {acc:.3f}", flush=True)
+
+    # held-out accuracy
+    model.eval()
+    test = build_corpus(n=200, seed=1)
+    test_y = [1 if lexicon_sentiment([t])[0] > 0 else 0 for t in test]
+    with torch.no_grad():
+        enc = tok(test, return_tensors="pt", padding=True, truncation=True, max_length=48)
+        pred = model(**enc).logits.argmax(-1).numpy()
+    acc = float((pred == np.asarray(test_y)).mean())
+    print(f"[rm] held-out acc {acc:.3f}")
+
+    model.save_pretrained(args.out)
+    tok.save_pretrained(args.out)
+    print(f"[rm] saved to {args.out}")
+
+
+if __name__ == "__main__":
+    main()

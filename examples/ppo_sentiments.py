@@ -9,9 +9,11 @@ sys.path.insert(0, ".")
 import trlx_tpu
 from examples.sentiment_task import (
     PROMPT_STUBS,
+    SENTIMENT_MODEL_DIR,
     TINY_MODEL_OVERRIDES,
     hf_task_available,
     lexicon_sentiment,
+    load_sentiment_scorer,
 )
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.default_configs import default_ppo_config
@@ -39,9 +41,15 @@ def build_config() -> TRLConfig:
     return config
 
 
+_SCORER = None
+
+
 def reward_fn(samples, outputs=None, **kwargs):
-    if hf_task_available():  # real sentiment model path
-        raise NotImplementedError("wire a local sentiment model here")
+    global _SCORER
+    if hf_task_available(SENTIMENT_MODEL_DIR):  # real model path (scores full samples, like the reference)
+        if _SCORER is None:
+            _SCORER = load_sentiment_scorer()
+        return _SCORER(samples)
     return lexicon_sentiment(outputs if outputs is not None else samples)
 
 

@@ -10,7 +10,7 @@ sys.path.insert(0, ".")
 
 import trlx_tpu
 from examples.ppo_sentiments import reward_fn
-from examples.sentiment_task import PROMPT_STUBS, lexicon_sentiment
+from examples.sentiment_task import PROMPT_STUBS
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.default_configs import default_ppo_config
 

@@ -9,7 +9,6 @@ import trlx_tpu
 from examples.randomwalks import generate_random_walks
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.default_configs import default_ilql_config
-from trlx_tpu.methods.ilql import ILQLConfig
 
 
 def default_config(alphabet: str) -> TRLConfig:

@@ -15,7 +15,6 @@ from trlx_tpu.data.configs import (
     TrainConfig,
     TRLConfig,
 )
-from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.methods.ppo import PPOConfig
 
 from examples.randomwalks import generate_random_walks

@@ -5,7 +5,7 @@ Works fully offline with the builtin char tokenizer (`char://<alphabet>`), repla
 the reference's custom HF tokenizer checkpoint (CarperAI/randomwalks); shortest paths
 use BFS instead of networkx."""
 
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 

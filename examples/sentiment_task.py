@@ -9,7 +9,7 @@ config.json), the real task is used instead — the example scripts don't change
 """
 
 import os
-from typing import Dict, List
+from typing import List
 
 import numpy as np
 
@@ -72,6 +72,53 @@ def build_corpus(n: int = 500, seed: int = 0) -> List[str]:
 
 def hf_task_available(model_path: str = "lvwerra/gpt2-imdb") -> bool:
     return os.path.isdir(model_path) and os.path.exists(os.path.join(model_path, "config.json"))
+
+
+SENTIMENT_MODEL_DIR = os.environ.get("TRLX_SENTIMENT_MODEL", "lvwerra/distilbert-imdb")
+
+
+def load_sentiment_scorer(model_dir: str = None, batch_size: int = 32):
+    """Load a local HF sequence-classification checkpoint as P(positive) scorer.
+
+    The reference scores rollouts with an HF ``sentiment-analysis`` pipeline on a
+    dedicated GPU (`/root/reference/examples/ppo_sentiments.py:21-52`, its
+    ``get_positive_score`` picks the POSITIVE label's softmax prob). The reward
+    model is host-side user code, not part of the TPU compute path, so torch-CPU
+    inference through transformers is the faithful counterpart here; the policy
+    itself stays on the TPU. Returns ``texts -> List[float]`` of positive-class
+    probabilities.
+    """
+    from transformers import pipeline  # local import: torch only on this path
+
+    model_dir = model_dir or SENTIMENT_MODEL_DIR
+    if not hf_task_available(model_dir):
+        raise FileNotFoundError(
+            f"no local sequence-classification checkpoint at {model_dir!r} "
+            "(set TRLX_SENTIMENT_MODEL to a local HF model dir)"
+        )
+    pipe = pipeline(
+        "text-classification", model=model_dir, tokenizer=model_dir,
+        device=-1, top_k=None, truncation=True,
+    )
+
+    def positive_prob(entries) -> float:
+        by_label = {e["label"].lower(): float(e["score"]) for e in entries}
+        for key, score in by_label.items():
+            if "pos" in key or key == "label_1":
+                return score
+        # Opaque labels: no way to know which class is "positive", but the
+        # objective must at least be a FIXED class — the pipeline sorts entries
+        # by score, so pick deterministically by label name instead.
+        return by_label[sorted(by_label)[-1]]
+
+    def score(texts: List[str]) -> List[float]:
+        out = []
+        for i in range(0, len(texts), batch_size):
+            chunk = [str(t) for t in texts[i : i + batch_size]]
+            out.extend(positive_prob(e) for e in pipe(chunk, batch_size=batch_size))
+        return out
+
+    return score
 
 
 TINY_MODEL_OVERRIDES = dict(

@@ -4,8 +4,7 @@ scalar head trained on (chosen, rejected) pairs with -log sigmoid(r_c - r_r) los
 Offline-capable: tiny random-init trunk + byte tokenizer when no checkpoints exist."""
 
 import sys
-from dataclasses import dataclass
-from typing import Callable, Dict, List, Tuple
+from typing import Callable, List, Tuple
 
 import numpy as np
 
@@ -17,7 +16,6 @@ from flax import linen as nn
 sys.path.insert(0, ".")
 
 from trlx_tpu.models.heads import MLPHead
-from trlx_tpu.models.presets import PRESETS
 from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
 from trlx_tpu.ops.generation import left_pad_batch
 from trlx_tpu.parallel.mesh import make_mesh, put_batch

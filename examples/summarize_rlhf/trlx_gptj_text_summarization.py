@@ -44,7 +44,7 @@ def make_dataset(n=400, seed=0):
     return rows
 
 
-def main(hparams={}):
+def main(hparams={}, base_dir="ckpts/summarize", sft_steps=150, rm_steps=150):
     rows = make_dataset()
 
     # ---- stage 1: SFT on (doc, good summary)
@@ -52,8 +52,8 @@ def main(hparams={}):
     d = sft_config.to_dict()
     d["method"] = SFTConfig(gen_kwargs=dict(max_new_tokens=8, top_k=1)).to_dict()
     d["train"].update(
-        seq_length=64, batch_size=32, total_steps=150, eval_interval=150,
-        checkpoint_interval=1000, checkpoint_dir="ckpts/summarize/sft", tracker="jsonl",
+        seq_length=64, batch_size=32, total_steps=sft_steps, eval_interval=sft_steps,
+        checkpoint_interval=1000, checkpoint_dir=f"{base_dir}/sft", tracker="jsonl",
     )
     d["model"].update(model_path="gpt2", model_overrides=dict(TINY))
     d["tokenizer"]["tokenizer_path"] = "bytes"
@@ -64,14 +64,14 @@ def main(hparams={}):
         eval_prompts=[rows[0][0]],
         config=sft_config,
     )
-    sft_dir = "ckpts/summarize/sft_model"
+    sft_dir = f"{base_dir}/sft_model"
     sft_trainer.save_pretrained(sft_dir)
 
     # ---- stage 2: pairwise reward model on (chosen, rejected)
     tokenizer = load_tokenizer(sft_config.tokenizer)
     rm_config = PRESETS["gpt2"].replace(**TINY, compute_dtype=np.float32)
     pairs = [(doc + good, doc + bad) for doc, good, bad in rows]
-    _, _, score_fn = train_reward_model(pairs, tokenizer, rm_config, steps=150)
+    _, _, score_fn = train_reward_model(pairs, tokenizer, rm_config, steps=rm_steps)
 
     # delta-vs-SFT normalization (parity: reference normalizes PPO rewards by the
     # reward of the dataset's reference summaries)
@@ -88,7 +88,7 @@ def main(hparams={}):
         train={
             "seq_length": 64, "batch_size": 32, "total_steps": 300,
             "eval_interval": 50, "checkpoint_interval": 10000,
-            "checkpoint_dir": "ckpts/summarize/ppo", "tracker": "jsonl",
+            "checkpoint_dir": f"{base_dir}/ppo", "tracker": "jsonl",
         },
         method={"chunk_size": 32, "num_rollouts": 64, "init_kl_coef": 0.05,
                 "gen_kwargs": {"max_new_tokens": 8, "top_k": 0, "top_p": 1.0, "do_sample": True}},
@@ -99,7 +99,7 @@ def main(hparams={}):
     ppo_config = TRLConfig.update(ppo_config.to_dict(), hparams)
 
     prompts = sorted({doc for doc, _, _ in rows[300:]})
-    trlx_tpu.train(
+    return trlx_tpu.train(
         reward_fn=reward_fn, prompts=prompts, eval_prompts=prompts[:16], config=ppo_config
     )
 

@@ -7,6 +7,9 @@ cd "$(dirname "$0")/.."
 
 HPARAMS='{"train.total_steps": 64, "train.eval_interval": 16, "train.tracker": null}'
 
+echo "== ci gate =="
+bash scripts/ci.sh
+
 echo "== randomwalks PPO =="
 python examples/randomwalks/ppo_randomwalks.py "$HPARAMS"
 echo "== randomwalks ILQL =="

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# CI gate (parity: the reference's PR workflow, .github/workflows/build.yml:33-40,
+# which runs flake8 + pre-commit + pytest). Run before merging/committing:
+#   bash scripts/ci.sh [--slow]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== syntax (compileall)"
+python -m compileall -q trlx_tpu examples tests scripts bench.py __graft_entry__.py
+
+echo "== lint (scripts/lint.py)"
+python scripts/lint.py trlx_tpu examples tests scripts bench.py __graft_entry__.py
+
+echo "== tests"
+if [[ "${1:-}" == "--slow" ]]; then
+    python -m pytest tests/ -q
+else
+    python -m pytest tests/ -q -m "not slow"
+fi
+echo "CI OK"

@@ -1,0 +1,154 @@
+"""From-scratch repo lint: the flake8-shaped subset the reference CI enforces.
+
+The reference gates every PR on flake8 + pre-commit (black/isort) in
+`.github/workflows/build.yml:33-40`. flake8 is not in this image, so this
+implements the highest-signal checks directly on the AST/token stream:
+
+  F401  imported name unused (module scope; respects __all__, ``# noqa``,
+        conventional re-export via ``import x as x``)
+  F811  import redefined before use
+  E999  syntax error
+  W291  trailing whitespace / W191 tab indentation
+  E501  line too long (default 120, like the reference's setup.cfg)
+
+Per-file ignores (the flake8 ``per-file-ignores`` convention): ``__init__.py``
+files skip F401 — package re-export surface.
+
+Usage: python scripts/lint.py PATH [PATH...]
+Exit code 1 if any finding.
+"""
+
+import ast
+import sys
+import tokenize
+from pathlib import Path
+
+MAX_LINE = 120
+
+
+def iter_py_files(paths):
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+def _noqa_lines(source: str):
+    noqa = set()
+    try:
+        for tok in tokenize.generate_tokens(iter(source.splitlines(True)).__next__):
+            if tok.type == tokenize.COMMENT and "noqa" in tok.string.lower():
+                noqa.add(tok.start[0])
+    except tokenize.TokenizeError:
+        pass
+    return noqa
+
+
+class ImportVisitor(ast.NodeVisitor):
+    """Collect module-scope imports and every name usage in the file."""
+
+    def __init__(self):
+        self.imports = []  # (name, lineno, is_reexport)
+        self.used = set()
+        self._depth = 0
+
+    def _add(self, alias: ast.alias, lineno: int):
+        bound = alias.asname or alias.name.split(".")[0]
+        reexport = alias.asname is not None and alias.asname == alias.name
+        self.imports.append((bound, lineno, reexport))
+
+    def visit_Import(self, node):
+        if self._depth == 0:
+            for a in node.names:
+                self._add(a, node.lineno)
+
+    def visit_ImportFrom(self, node):
+        if self._depth == 0:
+            for a in node.names:
+                if a.name != "*":
+                    self._add(a, node.lineno)
+
+    def _visit_scope(self, node):
+        self._depth += 1
+        self.generic_visit(node)
+        self._depth -= 1
+
+    visit_FunctionDef = visit_AsyncFunctionDef = visit_ClassDef = _visit_scope
+    visit_Lambda = _visit_scope
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+
+def lint_file(path: Path):
+    findings = []
+    try:
+        source = path.read_text()
+    except UnicodeDecodeError as e:
+        return [(path, 0, "E902", str(e))]
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, "E999", f"syntax error: {e.msg}")]
+
+    noqa = _noqa_lines(source)
+
+    # line-level checks
+    for i, line in enumerate(source.splitlines(), 1):
+        if i in noqa:
+            continue
+        if line != line.rstrip():
+            findings.append((path, i, "W291", "trailing whitespace"))
+        if line.startswith("\t"):
+            findings.append((path, i, "W191", "tab indentation"))
+        if len(line) > MAX_LINE and "http" not in line:
+            findings.append((path, i, "E501", f"line too long ({len(line)} > {MAX_LINE})"))
+
+    # unused / redefined module-scope imports
+    v = ImportVisitor()
+    v.visit(tree)
+    exported = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    try:
+                        exported = set(ast.literal_eval(node.value))
+                    except ValueError:
+                        pass
+    # string usage (docstrings referencing names, __getattr__ lazies) is not
+    # tracked; same blind spots as pyflakes.
+    seen = {}
+    is_pkg_init = path.name == "__init__.py"
+    for name, lineno, reexport in v.imports:
+        if lineno in noqa or reexport or name.startswith("_") or is_pkg_init:
+            continue
+        if name in seen and seen[name] not in noqa:
+            findings.append((path, lineno, "F811", f"redefinition of unused import {name!r} from line {seen[name]}"))
+        seen[name] = lineno
+        if name not in v.used and name not in exported:
+            findings.append((path, lineno, "F401", f"{name!r} imported but unused"))
+    return findings
+
+
+def main(argv):
+    paths = argv or ["trlx_tpu"]
+    all_findings = []
+    n_files = 0
+    for f in iter_py_files(paths):
+        n_files += 1
+        all_findings.extend(lint_file(f))
+    for path, lineno, code, msg in all_findings:
+        print(f"{path}:{lineno}: {code} {msg}")
+    print(f"lint: {n_files} files, {len(all_findings)} findings")
+    return 1 if all_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

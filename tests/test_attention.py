@@ -167,3 +167,125 @@ def test_gqa_decode_generation_matches_xla():
     np.testing.assert_array_equal(
         np.asarray(outs["xla"]["sequences"]), np.asarray(outs["flash"]["sequences"])
     )
+
+
+def make_gqa_inputs(B=2, H=4, Hkv=2, T=48, S=48, D=16, seed=5):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, Hkv, S, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, Hkv, S, D)), jnp.float32)
+    return q, k, v
+
+
+def test_flash_gqa_kernel_matches_xla():
+    """The kernel consumes grouped K/V directly (no repeat): query head h reads
+    kv head h // (H/Hkv) via the BlockSpec index map."""
+    q, k, v = make_gqa_inputs()
+    kv_valid = np.ones((2, 48), np.int32)
+    kv_valid[1, :9] = 0
+    kv_valid = jnp.asarray(kv_valid)
+    out = flash_attention(q, k, v, kv_valid, True, None, 16, 16, True)
+    ref = xla_attention(q, k, v, kv_valid, True, 1.0 / 4.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "B,H,Hkv,T,S,maskfrac",
+    [
+        (2, 2, 2, 64, 64, 0.0),
+        (2, 2, 2, 40, 72, 0.25),  # ragged: internal padding in both T and S
+        (1, 4, 2, 48, 48, 0.3),  # GQA: dk/dv sum over the query-head group
+        (2, 4, 1, 33, 62, 0.2),  # MQA + ragged
+    ],
+)
+def test_pallas_backward_matches_xla_backward(B, H, Hkv, T, S, maskfrac):
+    """Grad parity: the Pallas dq/dkv kernels against the XLA recompute fallback,
+    including left-padding masks, non-block-multiple shapes, and grouped heads."""
+    import trlx_tpu.ops.attention as attn
+
+    q, k, v = make_gqa_inputs(B=B, H=H, Hkv=Hkv, T=T, S=S, seed=7)
+    kv_valid = np.ones((B, S), np.int32)
+    kv_valid[0, : int(S * maskfrac)] = 0
+    kv_valid = jnp.asarray(kv_valid)
+
+    def loss(q, k, v):
+        out = flash_attention(q, k, v, kv_valid, True, None, 32, 32, True)
+        # non-uniform cotangent exercises dO properly
+        w = jnp.arange(out.size, dtype=jnp.float32).reshape(out.shape) / out.size
+        return jnp.sum(out * w) + jnp.sum(out**2)
+
+    prev = attn.BACKWARD_IMPL
+    try:
+        attn.BACKWARD_IMPL = "pallas"
+        gp = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        attn.BACKWARD_IMPL = "xla"
+        gx = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        attn.BACKWARD_IMPL = prev
+    for a, b, name in zip(gp, gx, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4, rtol=1e-4, err_msg=f"d{name}"
+        )
+
+
+def test_pallas_backward_fully_masked_row_is_zero():
+    """Rows with no valid keys (lse == -inf) must produce zero grads, not NaN."""
+    q, k, v = make_gqa_inputs(B=1, H=2, Hkv=2, T=16, S=16, seed=9)
+    kv_valid = jnp.zeros((1, 16), jnp.int32)  # everything masked
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, kv_valid, True, None, 16, 16, True) ** 2)
+
+    gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g in (gq, gk, gv):
+        assert np.all(np.isfinite(np.asarray(g)))
+        np.testing.assert_allclose(np.asarray(g), 0.0, atol=1e-6)
+
+
+def test_model_gqa_grouped_einsum_matches_repeat():
+    """Full model forward on a GQA config: the grouped-einsum XLA path must match
+    an explicit repeat-to-full-heads reference."""
+    from trlx_tpu.models.presets import PRESETS
+    from trlx_tpu.models.transformer import TransformerLM
+
+    base = PRESETS["llama"].replace(
+        vocab_size=32, hidden_size=16, num_layers=2, num_heads=4, num_kv_heads=2,
+        intermediate_size=32, max_position_embeddings=64, compute_dtype=jnp.float32,
+    )
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (2, 16), 1, 32)
+    mask = np.ones((2, 16), np.int32)
+    mask[0, :4] = 0
+    mask = jnp.asarray(mask)
+    model = TransformerLM(base)
+    params = model.init(rng, ids, mask)["params"]
+    logits, *_ = model.apply({"params": params}, ids, mask)
+
+    # reference: same params, kv heads materialized at full count by repeating
+    # the k/v projection kernels along the head axis
+    import flax
+    full = flax.core.unfreeze(params)
+    import jax.numpy as jnp_
+
+    def widen(leaf_name):
+        for lname, layer in full.items():
+            if not lname.startswith("layers_"):
+                continue
+            proj = layer["attn"][leaf_name]
+            kern = proj["kernel"]  # [hid, Hkv*D]
+            D = base.hidden_size // base.num_heads
+            kern = kern.reshape(kern.shape[0], 2, D)
+            kern = jnp_.repeat(kern, 2, axis=1).reshape(kern.shape[0], 4 * D)
+            proj["kernel"] = kern
+            if "bias" in proj:
+                b = proj["bias"].reshape(2, D)
+                proj["bias"] = jnp_.repeat(b, 2, axis=0).reshape(4 * D)
+
+    widen("k_proj")
+    widen("v_proj")
+    model_full = TransformerLM(base.replace(num_kv_heads=4))
+    logits_full, *_ = model_full.apply({"params": full}, ids, mask)
+    valid = np.asarray(mask)[:, :, None]
+    np.testing.assert_allclose(
+        np.asarray(logits) * valid, np.asarray(logits_full) * valid, atol=2e-4, rtol=1e-4
+    )

@@ -2,8 +2,6 @@
 the reference's llama→NeMo converter (`examples/llama_nemo/convert_llama_to_nemo.py`),
 made topology-independent: one converted store restores onto any mesh."""
 
-import json
-import os
 
 import numpy as np
 import pytest
@@ -191,3 +189,53 @@ def test_trainer_runs_from_native_checkpoint(native_dir, tmp_path):
         config=config,
     )
     assert trainer.iter_count >= 2
+
+
+def test_convert_missing_weights_raises(tmp_path):
+    """A preset name (no local weights) must NOT silently produce a random-init
+    'native checkpoint' (ADVICE r2): raising is the default, --allow-random the
+    explicit opt-in."""
+    from trlx_tpu import checkpointing
+
+    with pytest.raises(FileNotFoundError, match="allow-random"):
+        checkpointing.convert_hf_to_native("gpt2", str(tmp_path / "out"))
+    out = checkpointing.convert_hf_to_native(
+        "gpt2", str(tmp_path / "out2"), allow_random=True,
+        overrides=dict(vocab_size=32, hidden_size=16, num_layers=2, num_heads=2,
+                       max_position_embeddings=32),
+    )
+    cfg, params, model_type = checkpointing.restore_native(out)
+    assert model_type == "gpt2" and params is not None
+
+
+def test_restore_rejects_newer_format_version(tmp_path):
+    from trlx_tpu import checkpointing
+
+    out = checkpointing.convert_hf_to_native(
+        "gpt2", str(tmp_path / "out"), allow_random=True,
+        overrides=dict(vocab_size=32, hidden_size=16, num_layers=2, num_heads=2,
+                       max_position_embeddings=32),
+    )
+    meta = checkpointing.load_native_config(out)
+    meta["format_version"] = checkpointing.FORMAT_VERSION + 1
+    import json as _json
+    with open(out + "/" + checkpointing.NATIVE_CONFIG, "w") as f:
+        _json.dump(meta, f)
+    with pytest.raises(ValueError, match="format_version"):
+        checkpointing.restore_native(out)
+
+
+def test_native_config_tuple_fields_roundtrip(tmp_path):
+    """lora_targets is a tuple; JSON stores a list; restore must hand back a
+    tuple so config equality/replace semantics survive the round-trip."""
+    from trlx_tpu import checkpointing
+
+    out = checkpointing.convert_hf_to_native(
+        "gpt2", str(tmp_path / "out"), allow_random=True,
+        overrides=dict(vocab_size=32, hidden_size=16, num_layers=2, num_heads=2,
+                       max_position_embeddings=32, lora_r=2,
+                       lora_targets=("q_proj", "v_proj")),
+    )
+    cfg, _, _ = checkpointing.restore_native(out)
+    assert cfg.lora_targets == ("q_proj", "v_proj")
+    assert isinstance(cfg.lora_targets, tuple)

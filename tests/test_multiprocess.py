@@ -50,7 +50,8 @@ config = TRLConfig(
     train=TrainConfig(seq_length=16, epochs=1, total_steps=total_steps, batch_size=8,
                       checkpoint_interval=100000, eval_interval=100000,
                       checkpoint_dir=sys.argv[1], pipeline="PromptPipeline",
-                      trainer=trainer_name, tracker=None, seed=3),
+                      trainer=trainer_name, tracker=None, seed=3,
+                      reward_on_process_zero=(mode == "ppo_rpz")),
     model=ModelConfig(model_path="gpt2", num_layers_unfrozen=1 if mode == "ppo" else -1,
                       model_overrides=dict(vocab_size=len(ALPHABET)+3, hidden_size=32,
                                            num_layers=2, num_heads=2,
@@ -64,8 +65,14 @@ if mode == "sft":
     samples = [["ab", "cd"], ["ef", "gh"], ["a", "bc"], ["de", "fg"]] * 2
     trainer = trlx_tpu.train(samples=samples, config=config)
 else:
+    def reward_fn(samples, **kw):
+        if mode == "ppo_rpz":
+            # the process-0 + broadcast path must NEVER call reward_fn on
+            # other hosts (the served-RM contract); crash loudly if it does
+            assert jax.process_index() == 0, "reward_fn called off process 0"
+        return [float(s.count("a")) for s in samples]
     trainer = trlx_tpu.train(
-        reward_fn=lambda samples, **kw: [float(s.count("a")) for s in samples],
+        reward_fn=reward_fn,
         prompts=["ab", "cd ef", "gh", "a b c"] * 2, config=config,
     )
 batch = next(iter(trainer.create_train_dataloader()))
@@ -86,7 +93,7 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("mode", ["sft", "ppo"])
+@pytest.mark.parametrize("mode", ["sft", "ppo", "ppo_rpz"])
 def test_two_process_training(tmp_path, mode):
     port = _free_port()
     script = tmp_path / "child.py"

@@ -5,7 +5,6 @@ import os
 import socket
 import subprocess
 import sys
-import time
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
@@ -41,6 +40,80 @@ def test_reward_server_client_roundtrip():
         assert delta == [0.0, 0.0]
     finally:
         proc.terminate()  # plain python http server — safe to signal (no jax/TPU)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def _save_tiny_classifier(tmp_path) -> str:
+    """Save a tiny random-init HF sequence-classification checkpoint locally."""
+    from transformers import DistilBertConfig, DistilBertForSequenceClassification, DistilBertTokenizer
+
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "good", "bad", "movie", "the", "a"]
+    vocab_file = tmp_path / "vocab.txt"
+    vocab_file.write_text("\n".join(vocab))
+    model_dir = str(tmp_path / "tiny_sentiment")
+    tok = DistilBertTokenizer(str(vocab_file))
+    cfg = DistilBertConfig(
+        vocab_size=len(vocab), dim=32, n_layers=1, n_heads=2, hidden_dim=64,
+        num_labels=2, id2label={0: "NEGATIVE", 1: "POSITIVE"},
+        label2id={"NEGATIVE": 0, "POSITIVE": 1},
+    )
+    model = DistilBertForSequenceClassification(cfg)
+    model.save_pretrained(model_dir)
+    tok.save_pretrained(model_dir)
+    return model_dir
+
+
+def test_real_sentiment_scorer_local_checkpoint(tmp_path):
+    """The real reward path (parity: reference examples/ppo_sentiments.py:21-52
+    sentiment pipeline + get_positive_score) loads a *local* checkpoint and
+    returns P(POSITIVE) per sample."""
+    from examples.sentiment_task import load_sentiment_scorer
+
+    model_dir = _save_tiny_classifier(tmp_path)
+    score = load_sentiment_scorer(model_dir, batch_size=2)
+    texts = ["the movie good", "bad bad movie", "a the movie"]
+    out = score(texts)
+    assert len(out) == 3 and all(0.0 <= s <= 1.0 for s in out)
+    # Deterministic model: same text -> same score
+    assert score(["the movie good"])[0] == out[0]
+
+    import pytest
+
+    with pytest.raises(FileNotFoundError):
+        load_sentiment_scorer(str(tmp_path / "missing"))
+
+
+def test_reward_server_serves_real_checkpoint(tmp_path):
+    from examples.hh.reward_client import RemoteRewardClient
+
+    model_dir = _save_tiny_classifier(tmp_path)
+    port = _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO_ROOT, "examples/hh/serve_reward.py"),
+         "--port", str(port), "--model-dir", model_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, cwd=REPO_ROOT,
+    )
+    try:
+        seen = []
+        saw_checkpoint = False
+        for _ in range(50):  # skip import-time log noise
+            line = proc.stdout.readline()
+            seen.append(line)
+            saw_checkpoint |= "serving checkpoint" in line
+            if "listening" in line:
+                break
+        else:
+            raise AssertionError(f"server never came up: {seen}")
+        assert saw_checkpoint, seen
+        client = RemoteRewardClient(f"http://127.0.0.1:{port}/v2/models/reward/infer")
+        scores = client(samples=["good movie", "bad movie"],
+                        outputs=["good movie", "bad movie"])
+        assert len(scores) == 2 and all(0.0 <= s <= 1.0 for s in scores)
+    finally:
+        proc.terminate()
         try:
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:

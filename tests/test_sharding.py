@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec
 
-from trlx_tpu.parallel.mesh import batch_sharding, dp_size, make_mesh, put_batch
+from trlx_tpu.parallel.mesh import dp_size, make_mesh, put_batch
 from trlx_tpu.parallel.sharding import (
     default_lm_rules,
     make_param_specs,

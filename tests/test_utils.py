@@ -117,3 +117,20 @@ def test_adamw_8bit_converges_and_shrinks_state():
     tx = get_optimizer_class("adamw_8bit_bnb")(learning_rate=1e-3, weight_decay=0.01)
     s = tx.init({"w": jnp.zeros(8)})
     assert s["moments"]["w"]["m_q"].dtype == jnp.int8
+
+
+def test_pack_unpack_scores_roundtrip():
+    """Broadcast encoding for reward scores: scalars and ragged dense rewards."""
+    import numpy as np
+    from trlx_tpu.trainer.mesh_trainer import pack_scores, unpack_scores
+
+    header, padded, lens = pack_scores([1.0, -2.5, 3.0])
+    assert header.tolist() == [0, 1] and padded.shape == (3, 1)
+    assert unpack_scores(bool(header[0]), padded, lens) == [1.0, -2.5, 3.0]
+
+    dense = [np.array([0.1, 0.2]), np.array([0.3]), np.array([0.4, 0.5, 0.6])]
+    header, padded, lens = pack_scores(dense)
+    assert header.tolist() == [1, 3] and padded.shape == (3, 3)
+    out = unpack_scores(bool(header[0]), padded, lens)
+    for a, b in zip(out, dense):
+        np.testing.assert_allclose(a, b)

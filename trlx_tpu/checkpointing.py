@@ -52,7 +52,14 @@ def _config_to_jsonable(config) -> Dict[str, Any]:
         if isinstance(val, (str, int, float, bool, type(None), list, tuple)):
             out[field.name] = list(val) if isinstance(val, tuple) else val
         else:  # jnp dtypes and similar
-            out[field.name] = str(np.dtype(val))
+            try:
+                out[field.name] = str(np.dtype(val))
+            except TypeError as e:
+                raise TypeError(
+                    f"Config field {type(config).__name__}.{field.name} "
+                    f"({type(val).__name__}) is not JSON-serializable and not a "
+                    f"dtype; extend _config_to_jsonable for it"
+                ) from e
     return out
 
 
@@ -62,9 +69,16 @@ def convert_hf_to_native(
     dtype: Optional[str] = None,
     overrides: Optional[Dict[str, Any]] = None,
     seq2seq: bool = False,
+    allow_random: bool = False,
 ) -> str:
-    """Convert a local HF checkpoint dir (or preset name → random init) into a
-    native pre-converted checkpoint at ``out_dir``. Returns ``out_dir``.
+    """Convert a local HF checkpoint dir (or, with ``allow_random``, a preset
+    name → random init) into a native pre-converted checkpoint at ``out_dir``.
+    Returns ``out_dir``.
+
+    Missing weights RAISE by default: silently writing a random-init "native
+    checkpoint" would let a user train a large model from noise believing it is
+    pretrained. ``allow_random=True`` (CLI ``--allow-random``) opts into the
+    zero-egress/testing case explicitly.
 
     ``dtype`` optionally casts params at rest (e.g. ``bfloat16`` halves disk and
     restore bandwidth; optimizer master weights can still be f32 at runtime —
@@ -78,15 +92,25 @@ def convert_hf_to_native(
 
         config, params = load_pretrained_seq2seq(model_path, overrides)
         model_type = "t5"
-        if params is None:
-            raise FileNotFoundError(f"No local checkpoint at {model_path!r} to convert")
     else:
         from trlx_tpu.models.hf_loading import init_params, load_pretrained
 
         config, params, model_type = load_pretrained(model_path, overrides)
-        if params is None:
-            logger.warning(f"No weights at {model_path!r}; converting a random init")
-            params = init_params(config)
+    if params is None:
+        if seq2seq:
+            raise FileNotFoundError(
+                f"No local checkpoint at {model_path!r} to convert — pass a local "
+                f"HF checkpoint dir (random init is not supported for seq2seq "
+                f"conversion; --allow-random applies to causal models only)"
+            )
+        if not allow_random:
+            raise FileNotFoundError(
+                f"No local checkpoint at {model_path!r} to convert (HF hub names "
+                f"don't resolve in a zero-egress environment — pass a local HF "
+                f"checkpoint dir, or --allow-random for an explicit random init)"
+            )
+        logger.warning(f"No weights at {model_path!r}; converting a RANDOM init")
+        params = init_params(config)
     if dtype is not None:
         import jax.numpy as jnp
 
@@ -115,7 +139,11 @@ def convert_hf_to_native(
 def _cast_tree(tree, dtype):
     import jax
 
-    return jax.tree.map(lambda x: np.asarray(x).astype(dtype) if np.issubdtype(np.asarray(x).dtype, np.floating) else x, tree)
+    def cast(x):
+        x = np.asarray(x)
+        return x.astype(dtype) if np.issubdtype(x.dtype, np.floating) else x
+
+    return jax.tree.map(cast, tree)
 
 
 def _leaves(tree):
@@ -145,9 +173,15 @@ def _rebuild_config(meta: Dict[str, Any], overrides: Optional[Dict[str, Any]]):
         from trlx_tpu.models.t5 import T5Config as ConfigCls
     else:
         from trlx_tpu.models.transformer import TransformerConfig as ConfigCls
-    names = {f.name for f in dataclasses.fields(ConfigCls)}
-    # stored keys are filtered for forward-compat across format versions...
+    fields = {f.name: f for f in dataclasses.fields(ConfigCls)}
+    names = set(fields)
+    # stored keys are filtered for same-or-older format versions (newer formats
+    # are rejected in restore_native before reaching here)...
     cfg = {k: v for k, v in cfg.items() if k in names}
+    # JSON stores tuples as lists; restore tuple-defaulted fields (lora_targets)
+    for k, v in cfg.items():
+        if isinstance(v, list) and isinstance(fields[k].default, tuple):
+            cfg[k] = tuple(v)
     config = ConfigCls(**cfg)
     if overrides:
         # ...user overrides are NOT: a typo must fail like it does everywhere else
@@ -178,6 +212,13 @@ def restore_native(
     import orbax.checkpoint as ocp
 
     meta = load_native_config(path)
+    stored_version = int(meta.get("format_version", 0))
+    if stored_version > FORMAT_VERSION:
+        raise ValueError(
+            f"Native checkpoint at {path!r} has format_version={stored_version}, "
+            f"newer than this code's {FORMAT_VERSION}; restoring would silently "
+            f"drop fields — upgrade trlx_tpu instead"
+        )
     if expect_seq2seq is not None and bool(meta.get("seq2seq")) != expect_seq2seq:
         stored = "seq2seq" if meta.get("seq2seq") else "causal"
         wanted = "seq2seq" if expect_seq2seq else "causal"
@@ -222,6 +263,10 @@ def main(argv=None):
     conv.add_argument("--dtype", default=None, help="cast floating params (e.g. bfloat16)")
     conv.add_argument("--seq2seq", action="store_true")
     conv.add_argument("--override", action="append", default=[], metavar="KEY=VALUE")
+    conv.add_argument(
+        "--allow-random", action="store_true",
+        help="permit converting a random init when no weights exist at model_path",
+    )
     insp = sub.add_parser("inspect", help="print a native checkpoint's metadata")
     insp.add_argument("path")
     args = parser.parse_args(argv)
@@ -236,7 +281,7 @@ def main(argv=None):
                 overrides[key] = val
         convert_hf_to_native(
             args.model_path, args.out_dir, dtype=args.dtype,
-            overrides=overrides, seq2seq=args.seq2seq,
+            overrides=overrides, seq2seq=args.seq2seq, allow_random=args.allow_random,
         )
     else:
         meta = load_native_config(args.path)

@@ -9,7 +9,7 @@ sharding strategy (replacing the reference's accelerate/deepspeed & NeMo paralle
 
 from copy import deepcopy
 from dataclasses import asdict, dataclass, field
-from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+from typing import Any, Dict, List, Optional, Set
 
 import yaml
 
@@ -262,6 +262,14 @@ class TrainConfig:
     resume_from_checkpoint: Optional[str] = None
     reward_only_on_last: bool = False
     rollout_logging_dir: Optional[str] = None
+
+    # score with reward_fn on process 0 only and broadcast the results to every
+    # host. Default off: a pure python reward_fn is cheaper to run everywhere
+    # than to broadcast. Turn ON for served reward models (the hh RPC pattern,
+    # reference examples/hh/ppo_hh.py:108-222) — otherwise every host hits the
+    # server with identical requests (N-plicated load) and any nondeterminism in
+    # the server silently desyncs the hosts' training data.
+    reward_on_process_zero: bool = False
 
     # jax.profiler trace window (TPU equivalent of the reference's NeMo nsys knobs,
     # configs/nemo_configs/megatron_20b.yaml:128-133): traces steps

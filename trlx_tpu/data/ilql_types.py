@@ -3,7 +3,7 @@
 (they are imported by its NeMo trainers but missing from the snapshot — SURVEY.md §2.1
 "Known snapshot defect"). With pytrees they are one-liners."""
 
-from typing import Any, List, Tuple
+from typing import Any, List
 
 import flax.struct
 import jax

@@ -6,7 +6,7 @@ a dataclass holding its hyperparameters by name, and the method object also owns
 the algorithm's loss function (implemented in JAX in `trlx_tpu.models.losses`).
 """
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict
 
 from trlx_tpu.utils.registry import make_registry

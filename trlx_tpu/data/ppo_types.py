@@ -4,7 +4,6 @@ as flax.struct pytrees so batches flow through jit/pjit directly."""
 from typing import Any
 
 import flax.struct
-import numpy as np
 
 
 @flax.struct.dataclass

@@ -29,9 +29,9 @@ class AdaptiveKLController:
         self.horizon = horizon
 
     def update(self, current: float, n_steps: int):
-        proportional_error = jnp.clip(current / self.target - 1, -0.2, 0.2)
-        mult = 1 + proportional_error * n_steps / self.horizon
-        self.value *= float(mult)
+        # host-side scalar math: no device op / sync per step
+        proportional_error = min(0.2, max(-0.2, float(current) / self.target - 1))
+        self.value *= 1 + proportional_error * n_steps / self.horizon
 
 
 class FixedKLController:
@@ -126,6 +126,12 @@ class PPOConfig(MethodConfig):
     # remotely — the RPC round-trip hides behind device work). reward_fn then
     # runs on a worker thread, so it must be thread-safe.
     overlap_reward_scoring: bool = False
+    # prompts per *generation* device batch during make_experience (defaults to
+    # chunk_size). Decode is bandwidth-bound on the weights — every step streams
+    # all parameters regardless of batch — so the decode batch wants to be as
+    # wide as memory allows, independently of the reward/scoring chunk (measured
+    # on one v5e chip, gpt2-124M: 3.3x new-tok/s going 32 -> 128).
+    decode_batch_size: Optional[int] = None
 
     def kl_controller(self):
         if self.target is not None:
@@ -183,7 +189,10 @@ class PPOConfig(MethodConfig):
                 clipfrac=vf_clipfrac,
             ),
             old_values=dict(mean=masked_mean(old_values, mask)),
-            returns=dict(mean=masked_mean(returns, mask), std=jnp.sqrt(masked_mean((returns - masked_mean(returns, mask)) ** 2, mask))),
+            returns=dict(
+                mean=masked_mean(returns, mask),
+                std=jnp.sqrt(masked_mean((returns - masked_mean(returns, mask)) ** 2, mask)),
+            ),
             policy=dict(approx_kl=approx_kl, clipfrac=pg_clipfrac),
             ratio=jnp.sum(ratio * mask) / n,
             padding_percentage=1.0 - n / mask.size,

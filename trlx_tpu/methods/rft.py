@@ -6,7 +6,7 @@ that rises over ``n_improve_steps``, deduplicated, then SFT on the survivors."""
 from dataclasses import dataclass, field
 from typing import Any, Dict
 
-from trlx_tpu.data.method_configs import MethodConfig, register_method
+from trlx_tpu.data.method_configs import register_method
 from trlx_tpu.methods.sft import SFTConfig
 
 

@@ -503,7 +503,9 @@ def hf_state_dict_to_params(model_type: str, sd: Dict[str, np.ndarray], config: 
     return jax.tree.map(lambda x: np.asarray(x, dtype=np.float32), p)
 
 
-def params_to_hf_state_dict(model_type: str, params: Dict[str, Any], config: TransformerConfig) -> Dict[str, np.ndarray]:
+def params_to_hf_state_dict(
+    model_type: str, params: Dict[str, Any], config: TransformerConfig
+) -> Dict[str, np.ndarray]:
     if model_type not in CONVERTERS:
         raise ValueError(f"No converter for model_type {model_type!r}")
     params = jax.tree.map(lambda x: np.asarray(jax.device_get(x), dtype=np.float32), params)

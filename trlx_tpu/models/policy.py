@@ -10,12 +10,12 @@ Parity targets (all in `/root/reference/trlx/models/`):
   evaluated at gathered state/action positions.
 """
 
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
-from flax.core import freeze, unfreeze
+from flax.core import unfreeze
 
 from trlx_tpu.methods.ilql import batched_index_select
 from trlx_tpu.models.heads import ILQLHeads, ValueHead
@@ -155,7 +155,9 @@ class CausalLMWithILQLHeads(nn.Module):
         return self.ilql_heads(hidden, hidden)
 
 
-def init_value_branch_from_trunk(params: Dict[str, Any], config: TransformerConfig, num_value_layers: int) -> Dict[str, Any]:
+def init_value_branch_from_trunk(
+    params: Dict[str, Any], config: TransformerConfig, num_value_layers: int
+) -> Dict[str, Any]:
     """Copy the (pretrained) top-N trunk layers + final norm into the value-branch
     params (parity with the reference's ModelBranch deepcopy of pretrained blocks,
     modeling_ppo.py:523-533) so the value function starts from trunk features, not

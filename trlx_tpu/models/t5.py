@@ -14,7 +14,6 @@ import math
 from dataclasses import dataclass, replace
 from typing import Any, Dict, Optional, Tuple
 
-import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +38,13 @@ class T5Config:
     decoder_start_token_id: int = 0
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
+    # LoRA adapters (parity: reference peft support is architecture-agnostic,
+    # modeling_base.py:162-240 — T5 must not be excluded). Target names are the
+    # T5 projection modules: q/k/v/o (attention) and wi/wi_0/wi_1/wo (FFN) —
+    # HF peft's default for T5 is ("q", "v").
+    lora_r: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: Tuple[str, ...] = ("q", "v")
 
     @property
     def is_gated(self) -> bool:
@@ -104,16 +110,20 @@ class T5Attention(nn.Module):
     bidirectional: bool = True
 
     def setup(self):
+        from trlx_tpu.models.transformer import LoraDense
+
         c = self.config
         inner = c.num_heads * c.d_kv
-        dense = lambda feats: nn.Dense(
+        # same param layout as nn.Dense; low-rank adapters engage per target name
+        dense = lambda feats, name: LoraDense(
             feats, use_bias=False, dtype=c.compute_dtype, param_dtype=c.param_dtype,
             kernel_init=nn.initializers.normal(c.initializer_factor * (c.d_model**-0.5)),
+            r=c.lora_r if name in c.lora_targets else 0, alpha=c.lora_alpha,
         )
-        self.q = dense(inner)
-        self.k = dense(inner)
-        self.v = dense(inner)
-        self.o = dense(c.d_model)
+        self.q = dense(inner, "q")
+        self.k = dense(inner, "k")
+        self.v = dense(inner, "v")
+        self.o = dense(c.d_model, "o")
         if self.has_relative_bias:
             self.relative_attention_bias = nn.Embed(
                 c.relative_attention_num_buckets, c.num_heads,
@@ -182,10 +192,13 @@ class T5FFN(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        from trlx_tpu.models.transformer import LoraDense
+
         c = self.config
-        dense = lambda feats, name: nn.Dense(
+        dense = lambda feats, name: LoraDense(
             feats, use_bias=False, dtype=c.compute_dtype, param_dtype=c.param_dtype,
             kernel_init=nn.initializers.normal(c.initializer_factor * (c.d_model**-0.5)), name=name,
+            r=c.lora_r if name in c.lora_targets else 0, alpha=c.lora_alpha,
         )
         if c.is_gated:
             h = jax.nn.gelu(dense(c.d_ff, "wi_0")(x), approximate=True) * dense(c.d_ff, "wi_1")(x)

@@ -17,8 +17,8 @@ generation jits to a single XLA while-loop; activations can be sequence-sharded 
 """
 
 import math
-from dataclasses import dataclass, field, replace
-from typing import Any, Dict, List, Optional, Tuple
+from dataclasses import dataclass, replace
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -417,11 +417,6 @@ class Attention(nn.Module):
         # attention is a batched matvec, too fine-grained for TPU pallas grids,
         # and XLA's VPU reduce already streams the cache near bandwidth.
 
-        # grouped-query: repeat kv heads
-        if c.kv_heads != c.num_heads:
-            rep = c.num_heads // c.kv_heads
-            kh = jnp.repeat(kh, rep, axis=1)
-            vh = jnp.repeat(vh, rep, axis=1)
         if (
             c.attention_impl == "ring"
             and cache is None
@@ -434,8 +429,15 @@ class Attention(nn.Module):
             mesh = ambient_mesh()
             n = mesh.shape.get(MODEL_AXIS, 1) if mesh is not None else 1
             if mesh is not None and n > 1 and T % n == 0 and batch_divisible(mesh, B):
+                # ring expects full-head K/V: expand grouped heads for this path only
+                if c.kv_heads != c.num_heads:
+                    rep = c.num_heads // c.kv_heads
+                    rkh = jnp.repeat(kh, rep, axis=1)
+                    rvh = jnp.repeat(vh, rep, axis=1)
+                else:
+                    rkh, rvh = kh, vh
                 out = ring_attention(
-                    q.transpose(0, 2, 1, 3), kh, vh,
+                    q.transpose(0, 2, 1, 3), rkh, rvh,
                     mesh, axis_name=MODEL_AXIS, causal=True, scale=scale,
                     kv_valid=kv_valid, batch_axes=BATCH_AXES,
                 ).transpose(0, 2, 1, 3).astype(c.compute_dtype)
@@ -445,11 +447,29 @@ class Attention(nn.Module):
             # fall through to XLA when the mesh/shape can't ring
 
         if use_flash:
+            # the kernel maps query head h -> kv head h // rep natively: grouped
+            # K/V are never materialized at full head count
             from trlx_tpu.ops.attention import flash_attention
             out = flash_attention(
                 q.transpose(0, 2, 1, 3), kh, vh,
                 kv_valid, True, scale, 128, 128, jax.default_backend() == "cpu",
             ).transpose(0, 2, 1, 3).astype(c.compute_dtype)
+        elif c.kv_heads != c.num_heads:
+            # grouped-query einsum: batch scores over kv heads with the group as
+            # a free axis — the old jnp.repeat path copied the whole K/V cache to
+            # full head count every decode step, multiplying HBM traffic by
+            # num_heads/kv_heads on exactly the GQA models it targets
+            rep = c.num_heads // c.kv_heads
+            qg = q.reshape(B, T, c.kv_heads, rep, c.dim_per_head)
+            scores = jnp.einsum("btkrd,bksd->bkrts", qg, kh).astype(jnp.float32) * scale
+            bias = (
+                mask_bias[:, :, None]
+                if mask_bias.shape[1] == 1
+                else mask_bias.reshape(B, c.kv_heads, rep, *mask_bias.shape[2:])
+            )
+            probs = jax.nn.softmax(scores + bias, axis=-1).astype(c.compute_dtype)
+            # btkrd order flattens to head h = k*rep + r, matching the q reshape
+            out = jnp.einsum("bkrts,bksd->btkrd", probs, vh)
         else:
             # [B,H,T,S]
             scores = jnp.einsum("bthd,bhsd->bhts", q, kh).astype(jnp.float32) * scale

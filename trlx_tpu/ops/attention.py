@@ -1,13 +1,24 @@
-"""Attention ops: a Pallas TPU flash-attention kernel + XLA reference.
+"""Attention ops: Pallas TPU flash-attention forward + backward kernels + XLA reference.
 
 The reference relies on external CUDA attention kernels (HF/NeMo, SURVEY.md §2.4.5);
 this is the TPU-native equivalent. Forward is an online-softmax (FlashAttention-style)
 Pallas kernel: grid = (batch, heads, q_blocks, kv_blocks) with the kv axis innermost —
 TPU grids execute sequentially, so running max / denominator / accumulator live in
 VMEM scratch across kv steps and the output tile is written once on the last step.
-Causal blocks above the diagonal are skipped with ``@pl.when``. The backward pass
-recomputes attention in XLA (memory-efficient forward is what matters for the rollout
-path; training can additionally remat).
+Causal blocks above the diagonal are skipped with ``@pl.when``.
+
+Backward is the standard recompute-per-block scheme (two kernels, as in the in-tree
+TPU flash attention): the forward saves only O and the per-row logsumexp; backward
+recomputes P = exp(S - L) tile by tile, so training memory is O(T·block) rather than
+the O(T·S) score matrix the old XLA-recompute fallback materialized. ``dkv`` runs
+grid (B, Hkv, kv_blocks, q_blocks) accumulating dK/dV in VMEM across the inner q
+steps; ``dq`` runs the forward's grid accumulating dQ across kv steps. The XLA
+fallback is kept behind ``BACKWARD_IMPL`` and used for grad-parity tests.
+
+Grouped-query attention is native: K/V arrive with their own head count ``Hkv`` and
+the kernels map query head h -> kv head h // (H // Hkv) in the BlockSpec index maps,
+so grouped K/V are never materialized at full head count (the old path ``jnp.repeat``-ed
+them, multiplying HBM traffic by the group size).
 
 Masking model matches :mod:`trlx_tpu.models.transformer`: slot-based causality plus a
 [B, S] key-validity mask (left-padded prompts). Engaged on every multi-token forward:
@@ -15,6 +26,11 @@ the training loss, the logprob/value scoring passes, and generation *prefill* (w
 attends over the just-computed prefix k/v while the cache write happens separately).
 Only single-token decode steps stay on the XLA path. Arbitrary T/S are supported via
 internal padding + block selection (see ``_flash_forward``).
+
+Mosaic tiling note: small per-row tensors (kv mask, logsumexp, delta) are carried with
+a trailing lane dim equal to the array's own last dim (8 sublane-replicated lanes),
+which tiles legally where a bare [B, S]/(1, block) layout does not (observed as a
+real-TPU lowering failure in round 2; interpret mode on CPU never checks).
 """
 
 import functools
@@ -28,6 +44,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# "pallas" (default) or "xla": tests flip this to check grad parity between the
+# Pallas backward kernels and the XLA recompute fallback.
+BACKWARD_IMPL = "pallas"
+
+LANES = 8  # trailing lane width for per-row tensors (lse / delta / kv mask rows)
+
 
 def _flash_kernel(
     kv_valid_ref,  # [1, 1, 8, block_k] int32 (sublane-replicated, per kv block)
@@ -35,6 +57,7 @@ def _flash_kernel(
     k_ref,  # [1, 1, block_k, D]
     v_ref,  # [1, 1, block_k, D]
     o_ref,  # [1, 1, block_q, D]
+    lse_ref,  # [1, 1, block_q, LANES] f32 or None (when with_lse)
     m_scratch,  # [block_q, 1] f32
     l_scratch,  # [block_q, 1] f32
     acc_scratch,  # [block_q, D] f32
@@ -94,6 +117,9 @@ def _flash_kernel(
         # rows with no valid keys (fully masked) produce 0, not NaN
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0, ...] = (acc_scratch[...] / safe_l).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse = jnp.where(l > 0.0, m_scratch[...] + jnp.log(safe_l), NEG_INF)
+            lse_ref[0, 0, ...] = jnp.broadcast_to(lse, (block_q, LANES))
 
 
 def _pick_block(n: int, max_block: int) -> int:
@@ -102,9 +128,15 @@ def _pick_block(n: int, max_block: int) -> int:
     return max(b for b in range(8, min(max_block, n8) + 1, 8) if n8 % b == 0)
 
 
+def _kv_head_map(H: int, Hkv: int):
+    """Query head -> kv head index map factor for grouped-query attention."""
+    rep = H // Hkv
+    return lambda h: h // rep
+
+
 def _flash_forward(
     q: jnp.ndarray,  # [B, H, T, D]
-    k: jnp.ndarray,  # [B, H, S, D]
+    k: jnp.ndarray,  # [B, Hkv, S, D]
     v: jnp.ndarray,
     kv_valid: jnp.ndarray,  # [B, S] int32
     causal: bool,
@@ -112,7 +144,8 @@ def _flash_forward(
     block_q: int,
     block_k: int,
     interpret: bool,
-) -> jnp.ndarray:
+    with_lse: bool = False,
+):
     B, H, T, D = q.shape
     S = k.shape[2]
     # any T/S supported: pad to a sublane multiple and pick the largest block
@@ -129,27 +162,33 @@ def _flash_forward(
         k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_s), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_s), (0, 0)))
         kv_valid = jnp.pad(kv_valid, ((0, 0), (0, pad_s)))
-    out = _flash_padded(q, k, v, kv_valid, causal, scale, block_q, block_k, interpret)
-    return out[:, :, :T, :] if pad_t else out
+    out, lse = _flash_padded(
+        q, k, v, kv_valid, causal, scale, block_q, block_k, interpret, with_lse
+    )
+    if pad_t:
+        out = out[:, :, :T, :]
+        lse = lse[:, :, :T] if lse is not None else None
+    return (out, lse) if with_lse else out
 
 
-def _flash_padded(q, k, v, kv_valid, causal, scale, block_q, block_k, interpret):
-    B, H, T, D = q.shape
-    S = k.shape[2]
-    assert T % block_q == 0 and S % block_k == 0, (T, S, block_q, block_k)
-    kv_steps = S // block_k
-    grid = (B, H, T // block_q, kv_steps)
-
-    # Mosaic tiling rules: a block's last dim must be a multiple of 128 or equal
-    # the array's dim; its second-to-last a multiple of 8 or equal. A [B, S] mask
-    # blocked (1, block_k) satisfies neither when block_k < 128 (observed as a
-    # real-TPU lowering failure in round 2's bench — interpret mode on CPU never
-    # checks). Reshape to [B, kv_steps, 8, block_k] (sublane-replicated): the
-    # block (1, 1, 8, block_k) then tiles legally and costs 8·S int32 per row.
-    kv_valid_tiled = jnp.broadcast_to(
+def _tile_kv_valid(kv_valid, B, kv_steps, block_k):
+    """[B, S] -> [B, kv_steps, 8, block_k] sublane-replicated (tiles legally)."""
+    return jnp.broadcast_to(
         kv_valid.astype(jnp.int32).reshape(B, kv_steps, 1, block_k),
         (B, kv_steps, 8, block_k),
     )
+
+
+def _flash_padded(q, k, v, kv_valid, causal, scale, block_q, block_k, interpret, with_lse):
+    B, H, T, D = q.shape
+    Hkv, S = k.shape[1], k.shape[2]
+    assert T % block_q == 0 and S % block_k == 0, (T, S, block_q, block_k)
+    assert H % Hkv == 0, (H, Hkv)
+    kvh = _kv_head_map(H, Hkv)
+    kv_steps = S // block_k
+    grid = (B, H, T // block_q, kv_steps)
+
+    kv_valid_tiled = _tile_kv_valid(kv_valid, B, kv_steps, block_k)
 
     kernel = functools.partial(
         _flash_kernel,
@@ -159,17 +198,27 @@ def _flash_padded(q, k, v, kv_valid, causal, scale, block_q, block_k, interpret)
         block_k=block_k,
         kv_steps=kv_steps,
     )
-    return pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct((B, H, T, D), q.dtype)]
+    out_specs = [pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))]
+    if with_lse:
+        out_shape.append(jax.ShapeDtypeStruct((B, H, T, LANES), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i, j: (b, h, i, 0))
+        )
+    else:
+        kernel = functools.partial(_drop_last_ref, kernel)
+
+    res = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, 8, block_k), lambda b, h, i, j: (b, j, 0, 0)),  # kv_valid
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, kvh(h), j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, kvh(h), j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+        out_specs=out_specs if with_lse else out_specs[0],
+        out_shape=out_shape if with_lse else out_shape[0],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -177,10 +226,248 @@ def _flash_padded(q, k, v, kv_valid, causal, scale, block_q, block_k, interpret)
         ],
         interpret=interpret,
     )(kv_valid_tiled, q, k, v)
+    if with_lse:
+        out, lse = res
+        return out, lse[..., 0]  # [B, H, T]
+    return res, None
+
+
+def _drop_last_ref(kernel, *refs):
+    """Adapt the shared kernel to the no-lse pallas_call signature: insert
+    lse_ref=None between the single output ref and the scratch refs."""
+    # refs = (kv_valid, q, k, v, o, m_s, l_s, acc_s)
+    return kernel(*refs[:5], None, *refs[5:])
+
+
+# ----------------------------------------------------------------- backward
+
+
+def _flash_bwd_dkv_kernel(
+    kv_valid_ref,  # [1, 1, 8, block_k]
+    q_ref,  # [1, rep, block_q, D] — the kv head's whole query-head group
+    k_ref,  # [1, 1, block_k, D]
+    v_ref,  # [1, 1, block_k, D]
+    do_ref,  # [1, rep, block_q, D]
+    lse_ref,  # [1, rep, block_q, LANES]
+    delta_ref,  # [1, rep, block_q, LANES]
+    dk_ref,  # [1, 1, block_k, D] out
+    dv_ref,  # [1, 1, block_k, D] out
+    dk_scratch,  # [block_k, D] f32
+    dv_scratch,  # [block_k, D] f32
+    *,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    q_steps: int,
+    rep: int,
+):
+    kj = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scratch[...] = jnp.zeros_like(dk_scratch)
+        dv_scratch[...] = jnp.zeros_like(dv_scratch)
+
+    run = jnp.logical_or(
+        jnp.logical_not(causal), kj * block_k <= qi * block_q + (block_q - 1)
+    )
+
+    @pl.when(run)
+    def _step():
+        k = k_ref[0, 0].astype(jnp.float32)  # [bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = kv_valid_ref[0, 0, 0][None, :] > 0
+        if causal:
+            mask = jnp.logical_and(mask, k_pos <= q_pos)
+
+        # dK/dV for a kv head sum over its whole query-head group; the group is
+        # fetched as a block dim and the loop unrolls statically (rep is 1 for MHA)
+        for r in range(rep):
+            q = q_ref[0, r].astype(jnp.float32)  # [bq, D]
+            do = do_ref[0, r].astype(jnp.float32)
+            lse = lse_ref[0, r, :, :1]  # [bq, 1]
+            delta = delta_ref[0, r, :, :1]
+
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            ) * scale  # [bq, bk]
+            # fully-masked rows have lse == NEG_INF; guard the exp to avoid inf*0
+            lse_safe = jnp.where(lse > NEG_INF / 2, lse, 0.0)
+            p = jnp.where(mask, jnp.exp(s - lse_safe), 0.0)  # [bq, bk]
+            # dv += P^T dO
+            dv_scratch[...] += jax.lax.dot_general(
+                p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )  # [bq, bk]
+            ds = p * (dp - delta) * scale
+            # dk += dS^T Q
+            dk_scratch[...] += jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+
+    @pl.when(qi == q_steps - 1)
+    def _finalize():
+        dk_ref[0, 0, ...] = dk_scratch[...].astype(dk_ref.dtype)
+        dv_ref[0, 0, ...] = dv_scratch[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(
+    kv_valid_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dq_ref,  # [1, 1, block_q, D] out
+    dq_scratch,  # [block_q, D] f32
+    *,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    kv_steps: int,
+):
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scratch[...] = jnp.zeros_like(dq_scratch)
+
+    run = jnp.logical_or(
+        jnp.logical_not(causal), kj * block_k <= qi * block_q + (block_q - 1)
+    )
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :1]
+        delta = delta_ref[0, 0, :, :1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = kv_valid_ref[0, 0, 0][None, :] > 0
+        if causal:
+            mask = jnp.logical_and(mask, k_pos <= q_pos)
+        lse_safe = jnp.where(lse > NEG_INF / 2, lse, 0.0)
+        p = jnp.where(mask, jnp.exp(s - lse_safe), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale
+        dq_scratch[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(kj == kv_steps - 1)
+    def _finalize():
+        dq_ref[0, 0, ...] = dq_scratch[...].astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, kv_valid, out, lse, g, causal, scale, block_q, block_k, interpret):
+    """Pallas backward: recompute P per block from saved lse. Returns dq, dk, dv.
+
+    Two kernels: ``dkv`` runs grid (B, Hkv, kv_blocks, q_blocks) — one program per
+    *kv* head, its query-head group fetched as a block dimension so dK/dV sum over
+    the group without output-block write conflicts; ``dq`` runs the forward's grid
+    (B, H, q_blocks, kv_blocks) with dQ accumulated in VMEM across kv steps."""
+    B, H, T, D = q.shape
+    Hkv, S = k.shape[1], k.shape[2]
+    rep = H // Hkv
+    block_q = _pick_block(T, block_q)
+    block_k = _pick_block(S, block_k)
+    pad_t = -T % block_q
+    pad_s = -S % block_k
+    if pad_t or pad_s:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_t), (0, 0)))
+        g = jnp.pad(g, ((0, 0), (0, 0), (0, pad_t), (0, 0)))
+        out = jnp.pad(out, ((0, 0), (0, 0), (0, pad_t), (0, 0)))
+        # padded query rows: lse = NEG_INF marks them fully-masked (p == 0)
+        lse = jnp.pad(lse, ((0, 0), (0, 0), (0, pad_t)), constant_values=NEG_INF)
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_s), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_s), (0, 0)))
+        kv_valid = jnp.pad(kv_valid, ((0, 0), (0, pad_s)))
+    Tp, Sp = q.shape[2], k.shape[2]
+    q_steps, kv_steps = Tp // block_q, Sp // block_k
+    kvh = _kv_head_map(H, Hkv)
+
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [B,H,Tp]
+    lse_l = jnp.broadcast_to(lse[..., None], (B, H, Tp, LANES))
+    delta_l = jnp.broadcast_to(delta[..., None], (B, H, Tp, LANES))
+    kv_valid_tiled = _tile_kv_valid(kv_valid, B, kv_steps, block_k)
+
+    # block coordinate hk in a dim of block size `rep` addresses elements
+    # [hk*rep, (hk+1)*rep) — exactly kv head hk's query-head group
+    qo_spec = pl.BlockSpec((1, rep, block_q, D), lambda b, hk, kj, qi: (b, hk, qi, 0))
+    kv_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, hk, kj, qi: (b, hk, kj, 0))
+    row_spec = pl.BlockSpec((1, rep, block_q, LANES), lambda b, hk, kj, qi: (b, hk, qi, 0))
+    mask_spec = pl.BlockSpec((1, 1, 8, block_k), lambda b, hk, kj, qi: (b, kj, 0, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel,
+            causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+            q_steps=q_steps, rep=rep,
+        ),
+        grid=(B, Hkv, kv_steps, q_steps),
+        in_specs=[mask_spec, qo_spec, kv_spec, kv_spec, qo_spec, row_spec, row_spec],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hkv, Sp, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hkv, Sp, D), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(kv_valid_tiled, q, k, v, g, lse_l, delta_l)
+
+    dq_q_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, kj: (b, h, qi, 0))
+    dq_kv_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, kj: (b, kvh(h), kj, 0))
+    dq_row_spec = pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, qi, kj: (b, h, qi, 0))
+    dq_mask_spec = pl.BlockSpec((1, 1, 8, block_k), lambda b, h, qi, kj: (b, kj, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel,
+            causal=causal, scale=scale, block_q=block_q, block_k=block_k, kv_steps=kv_steps,
+        ),
+        grid=(B, H, q_steps, kv_steps),
+        in_specs=[dq_mask_spec, dq_q_spec, dq_kv_spec, dq_kv_spec, dq_q_spec, dq_row_spec, dq_row_spec],
+        out_specs=dq_q_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Tp, D), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(kv_valid_tiled, q, k, v, g, lse_l, delta_l)
+
+    if pad_t:
+        dq = dq[:, :, :T, :]
+    if pad_s:
+        dk = dk[:, :, :S, :]
+        dv = dv[:, :, :S, :]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 def xla_attention(q, k, v, kv_valid, causal: bool, scale: float) -> jnp.ndarray:
-    """Reference attention in plain XLA ([B,H,T,D] layout)."""
+    """Reference attention in plain XLA ([B,H,T,D] layout; grouped K/V repeated)."""
+    if k.shape[1] != q.shape[1]:
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     s = jnp.einsum("bhtd,bhsd->bhts", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
     T, S = s.shape[-2], s.shape[-1]
     mask = kv_valid[:, None, None, :] > 0
@@ -202,20 +489,31 @@ def flash_attention(
     q, k, v, kv_valid, causal: bool = True, scale: Optional[float] = None,
     block_q: int = 128, block_k: int = 128, interpret: bool = False,
 ):
-    """Flash attention, [B,H,T,D] layout. Differentiable: backward recomputes
-    attention in XLA (forward stays O(T) memory for the rollout path)."""
+    """Flash attention, [B,H,T,D] layout; K/V may carry fewer (grouped) heads.
+    Differentiable: backward runs Pallas dq/dkv kernels recomputing attention
+    per block from the saved logsumexp (O(T·block) memory, matching the memory
+    model of the reference's fused CUDA kernels — SURVEY.md §2.4.5)."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     return _flash_forward(q, k, v, kv_valid, causal, scale, block_q, block_k, interpret)
 
 
 def _fwd(q, k, v, kv_valid, causal, scale, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, kv_valid, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v, kv_valid)
+    scale_ = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    out, lse = _flash_forward(
+        q, k, v, kv_valid, causal, scale_, block_q, block_k, interpret, with_lse=True
+    )
+    return out, (q, k, v, kv_valid, out, lse)
 
 
 def _bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, kv_valid = res
+    q, k, v, kv_valid, out, lse = res
     scale_ = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+
+    if BACKWARD_IMPL == "pallas":
+        dq, dk, dv = _flash_backward(
+            q, k, v, kv_valid, out, lse, g, causal, scale_, block_q, block_k, interpret
+        )
+        return dq, dk, dv, None
 
     def ref(q, k, v):
         return xla_attention(q, k, v, kv_valid, causal, scale_)

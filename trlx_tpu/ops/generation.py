@@ -13,7 +13,6 @@ a ``logits_processor(params, hidden, logits, prev_token) -> logits`` hook evalua
 hidden state each step.
 """
 
-from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np

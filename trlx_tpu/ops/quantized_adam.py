@@ -11,8 +11,7 @@ in f32 → requantize happens inside the fused update, so XLA keeps the
 transient f32 moments out of long-lived HBM.
 """
 
-import functools
-from typing import Any, Callable, Optional, Union
+from typing import Callable, Union
 
 import jax
 import jax.numpy as jnp

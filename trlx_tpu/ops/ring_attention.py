@@ -9,6 +9,13 @@ block against its current K/V shard, then rotates K/V one hop around the ring wi
 ``jax.lax.ppermute`` over ICI. Peak memory per chip is O(S_local), enabling sequences
 far beyond a single chip's HBM.
 
+Training memory is O(S_local) too: a ``jax.custom_vjp`` saves only the local Q/K/V
+shards, output, and per-row logsumexp, then the backward *re-runs the ring* —
+recomputing P = exp(S - L) per visiting shard while dK/dV accumulators ride the ring
+back to their home device (n rotations = identity). Without this, autodiff through
+the fori_loop of ppermutes saved every step's rotated K/V (O(S_full) residuals per
+device), defeating the point of the ring.
+
 Causal structure at shard granularity: after ``step`` rotations device ``i`` holds
 the K/V shard originally on device ``(i - step) mod n``; it contributes fully when
 source < i, diagonally (within-shard causal) when source == i, and is skipped when
@@ -44,6 +51,133 @@ def _block_attn(q, k, v, m, l, acc, scale, mask):
     return m_new, l_new, acc_new
 
 
+def _shard_mask(causal, src, my, valid_cur, tri):
+    """Visiting-shard mask: key validity x shard-granularity causal structure."""
+    mask = valid_cur[:, None, None, :] > 0  # [B,1,1,Tk]
+    if causal:
+        sm = jnp.logical_or(src < my, jnp.logical_and(src == my, tri))
+        mask = jnp.logical_and(mask, sm[None, None])
+    return mask
+
+
+def _ring_fwd_local(q_loc, k_loc, v_loc, valid_loc, *, axis_name, n, causal, scale):
+    """Forward ring on local shards; returns (out, lse) with lse = m + log(l)."""
+    B, H, T, D = q_loc.shape
+    my = jax.lax.axis_index(axis_name)
+    tri = jnp.tril(jnp.ones((T, T), dtype=bool))
+
+    def body(step, carry):
+        k_cur, v_cur, valid_cur, m, l, acc = carry
+        src = (my - step) % n
+        mask = _shard_mask(causal, src, my, valid_cur, tri)
+        m, l, acc = _block_attn(q_loc, k_cur, v_cur, m, l, acc, scale, mask)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        valid_next = jax.lax.ppermute(valid_cur, axis_name, perm)
+        return (k_next, v_next, valid_next, m, l, acc)
+
+    m0 = jnp.full((B, H, T, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, T, 1), jnp.float32)
+    acc0 = jnp.zeros((B, H, T, D), jnp.float32)
+    _, _, _, m, l, acc = jax.lax.fori_loop(
+        0, n, body, (k_loc, v_loc, valid_loc, m0, l0, acc0)
+    )
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / safe_l).astype(q_loc.dtype)
+    lse = jnp.where(l > 0.0, m + jnp.log(safe_l), NEG_INF)[..., 0]  # [B,H,T]
+    return out, lse
+
+
+def _ring_bwd_local(q_loc, k_loc, v_loc, valid_loc, out_loc, lse_loc, g_loc,
+                    *, axis_name, n, causal, scale):
+    """Backward ring: dQ accumulates locally; dK/dV accumulators travel with
+    their K/V shard and arrive home after the full circle of n rotations."""
+    B, H, T, D = q_loc.shape
+    my = jax.lax.axis_index(axis_name)
+    tri = jnp.tril(jnp.ones((T, T), dtype=bool))
+    g32 = g_loc.astype(jnp.float32)
+    lse = lse_loc[..., None]  # [B,H,T,1]
+    lse_safe = jnp.where(lse > NEG_INF / 2, lse, 0.0)
+    delta = jnp.sum(g32 * out_loc.astype(jnp.float32), axis=-1, keepdims=True)
+
+    def body(step, carry):
+        k_cur, v_cur, valid_cur, dk_cur, dv_cur, dq = carry
+        src = (my - step) % n
+        mask = _shard_mask(causal, src, my, valid_cur, tri)
+        s = jnp.einsum(
+            "bhtd,bhsd->bhts", q_loc.astype(jnp.float32), k_cur.astype(jnp.float32)
+        ) * scale
+        p = jnp.where(mask, jnp.exp(s - lse_safe), 0.0)  # [B,H,T,Tk]
+        dv_cur = dv_cur + jnp.einsum("bhts,bhtd->bhsd", p, g32)
+        dp = jnp.einsum("bhtd,bhsd->bhts", g32, v_cur.astype(jnp.float32))
+        ds = p * (dp - delta) * scale
+        dq = dq + jnp.einsum("bhts,bhsd->bhtd", ds, k_cur.astype(jnp.float32))
+        dk_cur = dk_cur + jnp.einsum("bhts,bhtd->bhsd", ds, q_loc.astype(jnp.float32))
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        valid_next = jax.lax.ppermute(valid_cur, axis_name, perm)
+        dk_next = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_next = jax.lax.ppermute(dv_cur, axis_name, perm)
+        return (k_next, v_next, valid_next, dk_next, dv_next, dq)
+
+    zeros_kv = jnp.zeros((B, H, T, D), jnp.float32)
+    _, _, _, dk, dv, dq = jax.lax.fori_loop(
+        0, n, body, (k_loc, v_loc, valid_loc, zeros_kv, zeros_kv, zeros_kv)
+    )
+    return dq.astype(q_loc.dtype), dk.astype(k_loc.dtype), dv.astype(v_loc.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _ring_core(q, k, v, kv_valid, mesh, axis_name, causal, scale, batch_axes):
+    out, _ = _ring_fwd_sharded(q, k, v, kv_valid, mesh, axis_name, causal, scale, batch_axes)
+    return out
+
+
+def _specs(axis_name, batch_axes):
+    spec = P(batch_axes, None, axis_name, None)
+    vspec = P(batch_axes, axis_name)
+    rowspec = P(batch_axes, None, axis_name)
+    return spec, vspec, rowspec
+
+
+def _ring_fwd_sharded(q, k, v, kv_valid, mesh, axis_name, causal, scale, batch_axes):
+    n = mesh.shape[axis_name]
+    spec, vspec, rowspec = _specs(axis_name, batch_axes)
+    fn = functools.partial(
+        _ring_fwd_local, axis_name=axis_name, n=n, causal=causal, scale=scale
+    )
+    return shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec, vspec),
+        out_specs=(spec, rowspec), check_rep=False,
+    )(q, k, v, kv_valid)
+
+
+def _ring_core_fwd(q, k, v, kv_valid, mesh, axis_name, causal, scale, batch_axes):
+    out, lse = _ring_fwd_sharded(q, k, v, kv_valid, mesh, axis_name, causal, scale, batch_axes)
+    # O(S_local) residuals per device: local shards + output + logsumexp only
+    return out, (q, k, v, kv_valid, out, lse)
+
+
+def _ring_core_bwd(mesh, axis_name, causal, scale, batch_axes, res, g):
+    q, k, v, kv_valid, out, lse = res
+    n = mesh.shape[axis_name]
+    spec, vspec, rowspec = _specs(axis_name, batch_axes)
+    fn = functools.partial(
+        _ring_bwd_local, axis_name=axis_name, n=n, causal=causal, scale=scale
+    )
+    dq, dk, dv = shard_map(
+        fn, mesh=mesh,
+        in_specs=(spec, spec, spec, vspec, spec, rowspec, spec),
+        out_specs=(spec, spec, spec), check_rep=False,
+    )(q, k, v, kv_valid, out, lse, g)
+    return dq, dk, dv, None
+
+
+_ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -62,46 +196,13 @@ def ring_attention(
 
     Each step computes ONE online-softmax block: the shard-granularity causal
     structure (full / diagonal / skip) is folded into the block's mask instead of
-    computing masked and unmasked variants and selecting afterwards."""
+    computing masked and unmasked variants and selecting afterwards.
+
+    Differentiable with O(S_local) training memory (see module docstring)."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    n = mesh.shape[axis_name]
     if kv_valid is None:
         kv_valid = jnp.ones((q.shape[0], k.shape[2]), jnp.int32)
-
-    def local_fn(q_loc, k_loc, v_loc, valid_loc):
-        B, H, T, D = q_loc.shape
-        my = jax.lax.axis_index(axis_name)
-        tri = jnp.tril(jnp.ones((T, T), dtype=bool))
-
-        def body(step, carry):
-            k_cur, v_cur, valid_cur, m, l, acc = carry
-            src = (my - step) % n
-            # shard-granularity causal structure folded into one mask:
-            # src < my -> attend fully; src == my -> within-shard causal;
-            # src > my -> contribute nothing
-            mask = valid_cur[:, None, None, :] > 0  # [B,1,1,Tk]
-            if causal:
-                shard_mask = jnp.logical_or(src < my, jnp.logical_and(src == my, tri))
-                mask = jnp.logical_and(mask, shard_mask[None, None])
-            m, l, acc = _block_attn(q_loc, k_cur, v_cur, m, l, acc, scale, mask)
-            perm = [(i, (i + 1) % n) for i in range(n)]
-            k_next = jax.lax.ppermute(k_cur, axis_name, perm)
-            v_next = jax.lax.ppermute(v_cur, axis_name, perm)
-            valid_next = jax.lax.ppermute(valid_cur, axis_name, perm)
-            return (k_next, v_next, valid_next, m, l, acc)
-
-        m0 = jnp.full((B, H, T, 1), NEG_INF, jnp.float32)
-        l0 = jnp.zeros((B, H, T, 1), jnp.float32)
-        acc0 = jnp.zeros((B, H, T, D), jnp.float32)
-        _, _, _, m, l, acc = jax.lax.fori_loop(
-            0, n, body, (k_loc, v_loc, valid_loc, m0, l0, acc0)
-        )
-        safe_l = jnp.where(l == 0.0, 1.0, l)
-        return (acc / safe_l).astype(q_loc.dtype)
-
-    spec = P(batch_axes, None, axis_name, None)
-    vspec = P(batch_axes, axis_name)
-    return shard_map(
-        local_fn, mesh=mesh, in_specs=(spec, spec, spec, vspec), out_specs=spec,
-        check_rep=False,
-    )(q, k, v, kv_valid.astype(jnp.int32))
+    return _ring_core(
+        q, k, v, kv_valid.astype(jnp.int32), mesh, axis_name, causal, scale,
+        batch_axes,
+    )

@@ -5,7 +5,6 @@ the KV-cache generation loop "the single most performance-critical piece to buil
 These are its logit-space pieces; the loop lives in :mod:`trlx_tpu.ops.generation`.
 """
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

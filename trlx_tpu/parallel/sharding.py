@@ -9,7 +9,7 @@ largest remaining dim over ``fsdp``; TP shards feature dims over ``model``.
 """
 
 import re
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 

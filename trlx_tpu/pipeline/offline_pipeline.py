@@ -8,7 +8,7 @@ seq2seq variant (:252-289). Collation is numpy; trainers place batches on the me
 """
 
 from dataclasses import dataclass
-from typing import Any, Dict, Iterable, List, Optional, Tuple, Union
+from typing import Any, Dict, Iterable, List, Tuple, Union
 
 import numpy as np
 
@@ -84,7 +84,8 @@ class PromptPipeline(BasePipeline):
     """Tokenizes and stores prompts; prompts may be dicts carrying extra metadata keys
     which are forwarded to reward/metric functions (parity :118-188)."""
 
-    def __init__(self, prompts: List[Union[str, Dict[str, Any]]], max_prompt_length: int, tokenizer, add_special_tokens: bool = False):
+    def __init__(self, prompts: List[Union[str, Dict[str, Any]]], max_prompt_length: int,
+                 tokenizer, add_special_tokens: bool = False):
         super().__init__()
         self.tokenizer = tokenizer
 
@@ -109,7 +110,8 @@ class PromptPipeline(BasePipeline):
     def __len__(self) -> int:
         return len(self.prompts)
 
-    def create_loader(self, batch_size: int, shuffle: bool = False, drop_last: bool = False, seed: int = 0) -> NumpyLoader:
+    def create_loader(self, batch_size: int, shuffle: bool = False, drop_last: bool = False,
+                      seed: int = 0) -> NumpyLoader:
         def collate(xs: List[dict]) -> Dict[str, Any]:
             out: Dict[str, Any] = {
                 "input_ids": [np.asarray(x["input_ids"], np.int32) for x in xs]
@@ -199,7 +201,8 @@ class ILQLRolloutStorage(BaseRolloutStore):
     def __len__(self) -> int:
         return len(self.input_ids)
 
-    def create_loader(self, batch_size: int, shuffle: bool = True, drop_last: bool = True, seed: int = 0) -> NumpyLoader:
+    def create_loader(self, batch_size: int, shuffle: bool = True, drop_last: bool = True,
+                      seed: int = 0) -> NumpyLoader:
         return NumpyLoader(self, batch_size, ilql_collate_fn, shuffle=shuffle, drop_last=drop_last, seed=seed)
 
 
@@ -238,5 +241,6 @@ class ILQLSeq2SeqRolloutStorage(BaseRolloutStore):
     def __len__(self) -> int:
         return len(self.input_ids)
 
-    def create_loader(self, batch_size: int, shuffle: bool = True, drop_last: bool = True, seed: int = 0) -> NumpyLoader:
+    def create_loader(self, batch_size: int, shuffle: bool = True, drop_last: bool = True,
+                      seed: int = 0) -> NumpyLoader:
         return NumpyLoader(self, batch_size, ilql_seq2seq_collate_fn, shuffle=shuffle, drop_last=drop_last, seed=seed)

@@ -5,7 +5,7 @@ into :class:`PPORLBatch`, and JSON export for algorithm distillation."""
 import json
 import os
 import time
-from typing import Iterable, List, Optional
+from typing import Iterable, List
 
 import numpy as np
 
@@ -79,7 +79,8 @@ class PPORolloutStorage(BaseRolloutStore):
     def __len__(self) -> int:
         return len(self.history)
 
-    def create_loader(self, batch_size: int, shuffle: bool = False, drop_last: bool = True, seed: int = 0) -> NumpyLoader:
+    def create_loader(self, batch_size: int, shuffle: bool = False, drop_last: bool = True,
+                      seed: int = 0) -> NumpyLoader:
         return NumpyLoader(
             self, batch_size, lambda elems: ppo_collate_fn(self.pad_token_id, elems),
             shuffle=shuffle, drop_last=drop_last, seed=seed,

@@ -9,7 +9,7 @@ benchmark task `examples/randomwalks` likewise builds its own toy vocab —
 - anything else            → ``transformers.AutoTokenizer`` (local files / cache)
 """
 
-from typing import Iterable, List, Optional, Union
+from typing import Iterable, List, Union
 
 from trlx_tpu.data.configs import TokenizerConfig
 from trlx_tpu.utils import logging

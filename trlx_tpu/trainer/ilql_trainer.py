@@ -4,7 +4,7 @@ index bookkeeping), the ILQL loss driver, periodic Polyak target-Q sync, and the
 advantage-shaped generation used at evaluation.
 """
 
-from typing import Dict, List, Optional
+from typing import Dict
 
 import numpy as np
 
@@ -13,7 +13,7 @@ import jax.numpy as jnp
 
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.ilql_types import ILQLBatch
-from trlx_tpu.methods.ilql import ILQLConfig, batched_index_select, topk_mask
+from trlx_tpu.methods.ilql import ILQLConfig, batched_index_select
 from trlx_tpu.models.hf_loading import load_pretrained
 from trlx_tpu.models.heads import sync_target_q_heads as _sync_heads
 from trlx_tpu.models.policy import CausalLMWithILQLHeads
@@ -32,7 +32,8 @@ logger = logging.get_logger(__name__)
 BUCKETS = [2 ** i for i in range(2, 14)]
 
 
-def make_experience(samples, rewards, tokenizer=None, max_length: int = 2048, verbose: bool = True) -> ILQLRolloutStorage:
+def make_experience(samples, rewards, tokenizer=None, max_length: int = 2048,
+                    verbose: bool = True) -> ILQLRolloutStorage:
     """Tokenize dialogues and compute ILQL index bookkeeping (parity:
     accelerate_ilql_trainer.py:30-100): per-sample ``actions_ixs`` = positions whose
     *next* token is an output token; ``states_ixs`` = actions + terminal; rewards are

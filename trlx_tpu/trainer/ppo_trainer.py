@@ -9,8 +9,7 @@ SPMD programs; the reference's rank-0 ``broadcast``/``scatter`` of reward scores
 placed onto the mesh with the batch.
 """
 
-from functools import partial
-from typing import Callable, Dict, List, Optional
+from typing import Dict, List
 
 import numpy as np
 
@@ -20,7 +19,7 @@ import jax.numpy as jnp
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.ppo_types import PPORLBatch, PPORLElement
 from trlx_tpu.methods.ppo import PPOConfig
-from trlx_tpu.models.hf_loading import init_params, load_pretrained
+from trlx_tpu.models.hf_loading import load_pretrained
 from trlx_tpu.models.policy import (
     CausalLMWithValueHead,
     branch_param_subtree,
@@ -148,20 +147,35 @@ class PPOTrainer(MeshRLTrainer):
             self.ref_params = device_copy(self.params["transformer"])
 
     def _setup_seq2seq_model(self, overrides):
-        from trlx_tpu.models.hf_loading import load_pretrained_seq2seq
+        from trlx_tpu.models.hf_loading import load_pretrained_seq2seq, peft_overrides
         from trlx_tpu.models.policy import Seq2SeqLMWithValueHead
 
-        if self.config.model.peft_config:
+        peft = peft_overrides(self.config.model.peft_config)
+        if peft and "lora_r" not in peft:
             raise NotImplementedError(
-                "peft adapters are not implemented for the seq2seq (T5) path; "
-                "use num_layers_unfrozen for parameter-efficient seq2seq training"
+                "seq2seq (T5) peft supports LORA adapters; prefix/prompt tuning "
+                "is causal-only (T5Config has no virtual-token path)"
             )
+        if peft:
+            # T5 target names are q/k/v/o + wi/wi_0/wi_1/wo; the causal default
+            # target names (q_proj/v_proj) don't exist here
+            peft.setdefault("lora_targets", ("q", "v"))
+            t5_lora_names = {"q", "k", "v", "o", "wi", "wi_0", "wi_1", "wo"}
+            unknown = set(peft["lora_targets"]) - t5_lora_names
+            if unknown:
+                # a causal-style target list would otherwise silently build zero
+                # adapters and freeze the whole trunk (policy == reference)
+                raise ValueError(
+                    f"peft target_modules {sorted(unknown)} match no T5 module; "
+                    f"valid T5 LoRA targets: {sorted(t5_lora_names)}"
+                )
+            overrides = {**(overrides or {}), **peft}
 
         self.model_config, t5_params = load_pretrained_seq2seq(
             self.config.model.model_path, overrides, mesh=self.mesh
         )
         self.model_type = "t5"
-        self.peft_base_ref = False
+        self.peft_base_ref = bool(peft)
         self.decoder_start_token_id = self.model_config.decoder_start_token_id
         self.module = Seq2SeqLMWithValueHead(self.model_config)
         params = self.module.init(
@@ -190,7 +204,17 @@ class PPOTrainer(MeshRLTrainer):
                 f"num_layers_unfrozen={n_unfrozen} exceeds "
                 f"num_decoder_layers={self.model_config.num_decoder_layers}"
             )
-        if 0 < n_unfrozen < self.model_config.num_decoder_layers:
+        if self.peft_base_ref:
+            # adapters-only training: the KL reference is the SAME t5 params
+            # applied through a module with LoRA structurally disabled (mirrors
+            # the causal peft path / reference disable_adapter() forward_hydra)
+            from trlx_tpu.models.t5 import T5LM
+
+            self.base_t5_module = T5LM(self.model_config.replace(lora_r=0))
+            self.branch_start = None
+            self.frozen_branch_params = None
+            self.ref_params = None
+        elif 0 < n_unfrozen < self.model_config.num_decoder_layers:
             from trlx_tpu.models.policy import t5_branch_param_subtree
 
             self.branch_start = self.model_config.num_decoder_layers - n_unfrozen
@@ -208,6 +232,10 @@ class PPOTrainer(MeshRLTrainer):
 
     def trainable_path_predicate(self, path: str) -> bool:
         if getattr(self, "is_seq2seq", False):
+            if self.config.model.peft_config:
+                # adapters + heads only — the generic predicate already treats
+                # the t5 trunk like the causal transformer trunk
+                return super().trainable_path_predicate(path)
             n_unfrozen = self.config.model.num_layers_unfrozen
             if n_unfrozen < 0 or "t5" not in path:
                 return True
@@ -257,8 +285,12 @@ class PPOTrainer(MeshRLTrainer):
     # ------------------------------------------------------------- experience
 
     def add_prompt_pipeline(self, pipeline):
-        """Attach the prompt pipeline for rollouts (parity: :245-249)."""
-        loader = pipeline.create_loader(self.method.chunk_size, shuffle=True, seed=self.config.train.seed)
+        """Attach the prompt pipeline for rollouts (parity: :245-249). The loader
+        batches ``decode_batch_size`` prompts (generation is bandwidth-bound and
+        wants the widest batch that fits); reward/scoring still run per
+        ``chunk_size`` sub-chunk."""
+        batch = self.method.decode_batch_size or self.method.chunk_size
+        loader = pipeline.create_loader(batch, shuffle=True, seed=self.config.train.seed)
         self.prompt_iterator = infinite_loader(loader)
 
     def setup_rollout_logging(self, config):
@@ -286,6 +318,8 @@ class PPOTrainer(MeshRLTrainer):
             module, t5 = self.module, self._t5_module()
             start_tok = self.decoder_start_token_id
             branch_start = self.branch_start
+            peft_base_ref = self.peft_base_ref
+            base_t5 = getattr(self, "base_t5_module", None)
 
             def score_s2s(params, ref_params, frozen_branch, q_ids, q_mask, r_ids, r_mask):
                 Bs = q_ids.shape[0]
@@ -295,7 +329,15 @@ class PPOTrainer(MeshRLTrainer):
                 dec_mask = jnp.concatenate(
                     [jnp.ones((Bs, 1), jnp.int32), r_mask[:, :-1]], axis=1
                 )
-                if branch_start is not None:
+                if peft_base_ref:
+                    # same (frozen) t5 params, adapters structurally disabled
+                    logits, values, _ = module.apply(
+                        {"params": params}, q_ids, q_mask, dec_in, dec_mask
+                    )
+                    ref_logits, _, _ = base_t5.apply(
+                        {"params": params["t5"]}, q_ids, q_mask, dec_in, dec_mask
+                    )
+                elif branch_start is not None:
                     logits, values, enc, branch_hidden, pos_bias = module.apply(
                         {"params": params}, q_ids, q_mask, dec_in, dec_mask, branch_start,
                         method=module.forward_with_branch,
@@ -366,7 +408,9 @@ class PPOTrainer(MeshRLTrainer):
         all_scores_log = []
         self.clock.tick()
 
-        def generate_chunk(tokenizer):
+        def generate_chunks(tokenizer):
+            """One device generation at decode_batch_size, split into chunk_size
+            sub-chunks for reward_fn / the scoring forward."""
             batch = next(self.prompt_iterator)
             prompts = batch["input_ids"]
             metadata = {k: v for k, v in batch.items() if k != "input_ids"}
@@ -374,14 +418,31 @@ class PPOTrainer(MeshRLTrainer):
             str_samples, str_prompts, str_outputs, out_ids = self.decode(
                 prompts, samples, pad_len, append_eos=True, response_masks=resp_mask
             )
-            reward_kwargs = dict(
-                samples=str_samples, prompts=str_prompts, outputs=str_outputs,
-                tokenizer=tokenizer, **metadata,
-            )
-            return (prompts, out_ids), reward_kwargs
+            cs = self.method.chunk_size
+            subs = []
+            for i in range(0, len(prompts), cs):
+                sl = slice(i, i + cs)
+                reward_kwargs = dict(
+                    samples=str_samples[sl], prompts=str_prompts[sl],
+                    outputs=str_outputs[sl], tokenizer=tokenizer,
+                    **{k: v[sl] for k, v in metadata.items()},
+                )
+                subs.append(((prompts[sl], out_ids[sl]), reward_kwargs))
+            return subs
 
-        if self.method.overlap_reward_scoring:
+        overlap = self.method.overlap_reward_scoring
+        if overlap and self.config.train.reward_on_process_zero and jax.process_count() > 1:
+            # call_reward_fn broadcasts (a collective): running it on a worker
+            # thread while the main thread issues device work can interleave
+            # differently across hosts and deadlock — score serially instead
+            logger.warning(
+                "overlap_reward_scoring disabled: reward_on_process_zero broadcasts "
+                "scores and must run on the main thread"
+            )
+            overlap = False
+        if overlap:
             import copy
+            from collections import deque
             from concurrent.futures import ThreadPoolExecutor
 
             # reward_fn runs on a worker thread while the main thread keeps using
@@ -391,25 +452,29 @@ class PPOTrainer(MeshRLTrainer):
                 self._reward_tokenizer = copy.deepcopy(self.tokenizer)
             generated = 0  # count at generation time: len(ppo_rl_elements) lags
             with ThreadPoolExecutor(max_workers=1) as pool:
-                pending = None
-                while generated < num_rollouts or pending is not None:
+                pending = deque()
+                while generated < num_rollouts or pending:
                     if generated < num_rollouts:
-                        chunk, reward_kwargs = generate_chunk(self._reward_tokenizer)
-                        generated += len(chunk[0])
-                        fut = pool.submit(self.reward_fn, **reward_kwargs)
+                        new = [
+                            (chunk, pool.submit(self.reward_fn, **kw))
+                            for chunk, kw in generate_chunks(self._reward_tokenizer)
+                        ]
+                        generated += sum(len(chunk[0]) for chunk, _ in new)
                     else:
-                        chunk = fut = None
-                    if pending is not None:
-                        pchunk, pfut = pending
+                        new = []
+                    # drain the previous generation's scores while this one's
+                    # reward futures run behind the next device generation
+                    while pending:
+                        pchunk, pfut = pending.popleft()
                         self._score_and_store(
                             pchunk, pfut.result(), ppo_rl_elements, accumulated_kl, all_scores_log
                         )
-                    pending = (chunk, fut) if chunk is not None else None
+                    pending.extend(new)
         else:
             while len(ppo_rl_elements) < num_rollouts:
-                chunk, reward_kwargs = generate_chunk(self.tokenizer)
-                scores = self.reward_fn(**reward_kwargs)
-                self._score_and_store(chunk, scores, ppo_rl_elements, accumulated_kl, all_scores_log)
+                for chunk, reward_kwargs in generate_chunks(self.tokenizer):
+                    scores = self.call_reward_fn(**reward_kwargs)
+                    self._score_and_store(chunk, scores, ppo_rl_elements, accumulated_kl, all_scores_log)
 
         self.mean_kl = float(np.mean(accumulated_kl))
         rollout_time = self.clock.tick()

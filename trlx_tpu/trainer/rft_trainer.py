@@ -6,7 +6,6 @@ the reference's ``labels = input_ids``).
 """
 
 from collections import defaultdict
-from typing import Dict, List
 
 import numpy as np
 

@@ -33,7 +33,9 @@ def train(
     surface is identical (model_path, reward_fn, samples, rewards, prompts,
     eval_prompts, metric_fn, config, stop_sequences)."""
     if config is None:
-        logger.warning("Passing the `config` argument implicitly is depreciated, use or adapt one of the default configs instead")
+        logger.warning(
+            "Passing the `config` argument implicitly is depreciated, use or adapt one of the default configs instead"
+        )
         if reward_fn:
             config = default_ppo_config()
         elif rewards:

@@ -62,7 +62,9 @@ def whiten(xs: jnp.ndarray, shift_mean: bool = True, mask: Optional[jnp.ndarray]
     return whitened
 
 
-def get_global_statistics(xs: jnp.ndarray, axis_name: Optional[str] = None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+def get_global_statistics(
+    xs: jnp.ndarray, axis_name: Optional[str] = None
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """(mean, var, count) of ``xs``. With ``axis_name`` set, reduces across that named
     mesh axis too (for use inside ``shard_map``); otherwise relies on global-view SPMD."""
     if axis_name is None:
