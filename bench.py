@@ -106,10 +106,6 @@ def _gpt2_perf_impl(jax, impl):
     params = jax.device_put(jax.tree.map(lambda x: np.asarray(x), params))
     trunk = TransformerLM(config)
 
-    def step(p, t_ids, t_mask, positions, cache):
-        logits, hidden, _, cache = trunk.apply({"params": p}, t_ids, t_mask, positions, cache)
-        return logits, hidden, cache
-
     trunk_params = params["transformer"]
     dtype_bytes = 2 if config.compute_dtype == jnp.bfloat16 else 4  # KV-cache dtype
     # size params by their STORED dtype — that is what streams from HBM each
@@ -117,12 +113,18 @@ def _gpt2_perf_impl(jax, impl):
     param_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(trunk_params))
     bw = _peak_bw(jax.devices()[0].device_kind)
 
-    def time_decode(b):
+    def time_decode(b, use_trunk=None):
+        dtrunk = use_trunk or trunk
         ids = jnp.asarray(rng.integers(1, V, (b, P)), jnp.int32)
         mask = jnp.ones((b, P), jnp.int32)
+
+        def dstep(p, t_ids, t_mask, positions, cache):
+            logits, hidden, _, cache = dtrunk.apply({"params": p}, t_ids, t_mask, positions, cache)
+            return logits, hidden, cache
+
         decode_fn = jax.jit(
             lambda p, i, m, r: generate(
-                step, p, lambda bb, s: trunk.init_cache(bb, s), i, m, r,
+                dstep, p, lambda bb, s: dtrunk.init_cache(bb, s), i, m, r,
                 max_new_tokens=N, eos_token_id=None, pad_token_id=0, do_sample=True,
             )["sequences"]
         )
@@ -158,6 +160,15 @@ def _gpt2_perf_impl(jax, impl):
     if not on_cpu:
         dt32 = time_decode(32)
         out["gpt2_rollout_new_tok_s_b32"] = round(32 * N / dt32, 1)
+        # int8 KV cache: at wide batch the KV cache dominates decode HBM traffic,
+        # so halving its bytes raises the roofline (TransformerConfig.kv_cache_quant)
+        qtrunk = TransformerLM(config.replace(kv_cache_quant=True))
+        dt_q = time_decode(B, use_trunk=qtrunk)
+        out["gpt2_rollout_new_tok_s_int8kv"] = round(B * N / dt_q, 1)
+        # int8 values (1 byte/elt) + one f32 scale per dim_per_head-element row
+        kv_elems = kv_step_bytes // dtype_bytes
+        kv_q_bytes = kv_elems + kv_elems * 4 // config.dim_per_head
+        out["gpt2_rollout_bw_bound_tok_s_int8kv"] = round(bw / (param_bytes + kv_q_bytes) * B, 1)
     B = 32 if not on_cpu else B  # train leg keeps its round-2 shape for comparability
 
     # PPO train step: fwd+bwd over [B, P+R]

@@ -161,3 +161,54 @@ def test_pad_to_bucket():
     assert pad_to_bucket(5, [8, 16]) == 8
     assert pad_to_bucket(9, [8, 16]) == 16
     assert pad_to_bucket(40, [8, 16]) == 64
+
+
+@pytest.mark.parametrize("layout", ["list", "stacked", "gqa"])
+def test_int8_kv_cache_decode_matches_fp_cache(layout):
+    """kv_cache_quant=True: decode over an int8 KV cache (per-row symmetric
+    quantization, scales per (b,h,slot)) must track the full-precision cache —
+    same logits up to quantization noise and near-identical greedy choices."""
+    overrides = dict(TINY)
+    if layout == "stacked":
+        overrides["scan_layers"] = True
+    if layout == "gqa":
+        overrides.update(num_heads=4, num_kv_heads=2, hidden_size=32)
+    base = PRESETS["gpt2"].replace(**overrides)
+    model = TransformerLM(base)
+    rng = jax.random.PRNGKey(3)
+    ids = jnp.ones((1, 4), jnp.int32)
+    params = model.init(rng, ids, jnp.ones_like(ids))["params"]
+    qmodel = TransformerLM(base.replace(kv_cache_quant=True))
+
+    prompts = [np.array([5, 9, 11, 2, 30], np.int32), np.array([7, 3], np.int32)]
+    pids, pmask = left_pad_batch(prompts, pad_token_id=0, target_len=8)
+    outs = {}
+    for name, m in (("fp", model), ("int8", qmodel)):
+        outs[name] = generate(
+            model_step_fn(m), params, lambda b, s, m=m: m.init_cache(b, s),
+            jnp.asarray(pids), jnp.asarray(pmask), jax.random.PRNGKey(0),
+            max_new_tokens=6, do_sample=False, pad_token_id=0,
+        )
+    cache = qmodel.init_cache(2, 8)
+    assert cache["k"][0].dtype == jnp.int8 if isinstance(cache["k"], list) else cache["k"].dtype == jnp.int8
+    # greedy paths agree except where quantization noise flips a near-tie
+    fp = np.asarray(outs["fp"]["sequences"])[:, 8:]
+    q8 = np.asarray(outs["int8"]["sequences"])[:, 8:]
+    agree = (fp == q8).mean()
+    assert agree >= 0.75, (fp, q8)
+
+    # teacher-forced single-token decode over a pad-free prompt: logits must
+    # stay close to the cache-free forward (drift = accumulated quant noise)
+    seq = jnp.asarray(np.array([[5, 9, 11, 2, 30, 7, 3, 22]], np.int32))
+    mask = jnp.ones_like(seq)
+    ref_logits, *_ = model.apply({"params": params}, seq, mask)
+    c = qmodel.init_cache(1, 8)
+    logits_steps = []
+    for t in range(8):
+        lt, _, _, c = qmodel.apply(
+            {"params": params}, seq[:, t : t + 1], mask, None, c
+        )
+        logits_steps.append(lt[:, 0])
+    got = jnp.stack(logits_steps, axis=1)
+    err = float(jnp.max(jnp.abs(got - ref_logits)))
+    assert err < 0.5, err
