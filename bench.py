@@ -54,6 +54,107 @@ def _peak_bw(device_kind: str) -> float:
     return _chip_const(device_kind, PEAK_HBM_BW, 819e9)
 
 
+def _fwd_flops_tok_fn(config):
+    """FLOPs of one token's forward at context length ctx (matmuls + attention)."""
+    d, L, V = config.hidden_size, config.num_layers, config.vocab_size
+    return lambda ctx: L * (24 * d * d + 4 * ctx * d) + 2 * d * V
+
+
+def _rollout_flops(fwd_flops_tok, B, P, N):
+    """FLOPs of one full rollout: prefill over P prompt tokens + N decode steps."""
+    return B * (P * fwd_flops_tok(P // 2) + N * fwd_flops_tok(P + N // 2))
+
+
+def _kv_step_bytes(config, B, P, N, kv_dtype_bytes):
+    """Mean KV-cache bytes read from HBM per decode step (context P + N/2).
+    ``kv_dtype_bytes=None`` means the int8 cache: 1 byte per element plus one
+    f32 scale per dim_per_head-element row (kv_cache_quant layout)."""
+    elems = 2 * config.num_layers * config.kv_heads * config.dim_per_head * (P + N // 2) * B
+    if kv_dtype_bytes is None:
+        return elems + elems * 4 // config.dim_per_head
+    return elems * kv_dtype_bytes
+
+
+def _time_decode(jax, trunk, trunk_params, B, P, N, reps, seed=0):
+    """Seconds per full rollout (prefill + N decode steps) at batch B: compile
+    once, then average reps timed runs."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from trlx_tpu.ops.generation import generate
+
+    rng = np.random.default_rng(seed)
+    ids = jnp.asarray(rng.integers(1, trunk.config.vocab_size, (B, P)), jnp.int32)
+    mask = jnp.ones((B, P), jnp.int32)
+
+    def dstep(p, t_ids, t_mask, positions, cache):
+        logits, hidden, _, cache = trunk.apply({"params": p}, t_ids, t_mask, positions, cache)
+        return logits, hidden, cache
+
+    decode_fn = jax.jit(
+        lambda p, i, m, r: generate(
+            dstep, p, lambda bb, s: trunk.init_cache(bb, s), i, m, r,
+            max_new_tokens=N, eos_token_id=None, pad_token_id=0, do_sample=True,
+        )["sequences"]
+    )
+    res = decode_fn(trunk_params, ids, mask, jax.random.PRNGKey(1))
+    jax.block_until_ready(res)  # compile
+    t0 = time.time()
+    for i in range(reps):
+        res = decode_fn(trunk_params, ids, mask, jax.random.PRNGKey(2 + i))
+    jax.block_until_ready(res)
+    return (time.time() - t0) / reps
+
+
+def _time_ppo_train_step(jax, module, params, tx, B, P, R, steps, seed=0):
+    """Seconds per PPO fwd+bwd+update step over [B, P+R] (compile excluded).
+    Returns (dt, params, opt_state) — params are donated each step."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from trlx_tpu.methods.ppo import PPOConfig
+    from trlx_tpu.utils.modeling import logprobs_of_labels
+
+    method = PPOConfig()
+    rng = np.random.default_rng(seed)
+    V = module.config.vocab_size
+    seq = jnp.asarray(rng.integers(1, V, (B, P + R)), jnp.int32)
+    full_mask = jnp.ones((B, P + R), jnp.int32)
+    old_lp = jnp.asarray(rng.normal(size=(B, R)), jnp.float32)
+    old_v = jnp.asarray(rng.normal(size=(B, R)), jnp.float32)
+    rew = jnp.asarray(rng.normal(size=(B, R)), jnp.float32)
+    r_mask = jnp.ones((B, R), jnp.int32)
+    opt_state = jax.jit(tx.init)(params)
+    jax.block_until_ready(opt_state)
+
+    def loss_fn(p):
+        logits, values_pred, _, _ = module.apply({"params": p}, seq, full_mask)
+        logprobs = logprobs_of_labels(logits[:, :-1], seq[:, 1:])
+        start = P - 1
+        logprobs = logprobs[:, start : start + R]
+        values_pred = values_pred[:, start : start + R].astype(jnp.float32)
+        adv, ret = method.get_advantages_and_returns(old_v, rew, r_mask)
+        loss, _ = method.loss(logprobs, values_pred, old_lp, old_v, adv, ret, r_mask)
+        return loss
+
+    # donate params/opt state like the real trainer's train_step does — without
+    # donation XLA copies the full param tree every step
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(p, s):
+        grads = jax.grad(loss_fn)(p)
+        updates, s2 = tx.update(grads, s, p)
+        return optax.apply_updates(p, updates), s2
+
+    params, opt_state = train_step(params, opt_state)
+    jax.block_until_ready(params)  # compile
+    t0 = time.time()
+    for _ in range(steps):
+        params, opt_state = train_step(params, opt_state)
+    jax.block_until_ready(params)
+    return (time.time() - t0) / steps, params, opt_state
+
+
 def _gpt2_perf(jax):
     """gpt2-124M perf with the flash kernel, falling back to XLA attention if the
     Pallas path fails to compile on this backend."""
@@ -78,27 +179,25 @@ def _gpt2_perf_impl(jax, impl):
     import numpy as np
     import optax
 
-    from trlx_tpu.methods.ppo import PPOConfig
     from trlx_tpu.models.policy import CausalLMWithValueHead
-    from trlx_tpu.models.transformer import TransformerLM
-    from trlx_tpu.ops.generation import generate
-    from trlx_tpu.utils.modeling import logprobs_of_labels
-
     from trlx_tpu.models.presets import PRESETS
+    from trlx_tpu.models.transformer import TransformerLM
 
     out = {}
     on_cpu = jax.default_backend() == "cpu"
     config = PRESETS["gpt2"].replace(
         compute_dtype=jnp.float32 if on_cpu else jnp.bfloat16, attention_impl=impl
     )
-    d, L, V = config.hidden_size, config.num_layers, config.vocab_size
-    fwd_flops_tok = lambda ctx: L * (24 * d * d + 4 * ctx * d) + 2 * d * V
-    peak = _peak_flops(jax.devices()[0].device_kind)
+    fwd_flops_tok = _fwd_flops_tok_fn(config)
+    kind = jax.devices()[0].device_kind
+    peak, bw = _peak_flops(kind), _peak_bw(kind)
 
     # CPU fallback can't turn 124M shapes around inside the child deadline; scale
     # down so the same code path still runs (numbers tagged by platform anyway)
     B, P, N = (2, 32, 8) if on_cpu else (256, 128, 128)
+    reps = 1 if on_cpu else 3
     rng = np.random.default_rng(0)
+    V = config.vocab_size
 
     module = CausalLMWithValueHead(config)
     init_ids = jnp.asarray(rng.integers(1, V, (1, 8)), jnp.int32)
@@ -111,107 +210,41 @@ def _gpt2_perf_impl(jax, impl):
     # size params by their STORED dtype — that is what streams from HBM each
     # decode step (param_dtype may be f32 while compute_dtype is bf16)
     param_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(trunk_params))
-    bw = _peak_bw(jax.devices()[0].device_kind)
-
-    def time_decode(b, use_trunk=None):
-        dtrunk = use_trunk or trunk
-        ids = jnp.asarray(rng.integers(1, V, (b, P)), jnp.int32)
-        mask = jnp.ones((b, P), jnp.int32)
-
-        def dstep(p, t_ids, t_mask, positions, cache):
-            logits, hidden, _, cache = dtrunk.apply({"params": p}, t_ids, t_mask, positions, cache)
-            return logits, hidden, cache
-
-        decode_fn = jax.jit(
-            lambda p, i, m, r: generate(
-                dstep, p, lambda bb, s: dtrunk.init_cache(bb, s), i, m, r,
-                max_new_tokens=N, eos_token_id=None, pad_token_id=0, do_sample=True,
-            )["sequences"]
-        )
-        res = decode_fn(trunk_params, ids, mask, jax.random.PRNGKey(1))
-        jax.block_until_ready(res)  # compile
-        reps = 1 if on_cpu else 3
-        t0 = time.time()
-        for i in range(reps):
-            res = decode_fn(trunk_params, ids, mask, jax.random.PRNGKey(2 + i))
-        jax.block_until_ready(res)
-        return (time.time() - t0) / reps
 
     # decode batch decoupled from the reward chunk (PPOConfig.decode_batch_size):
     # the weights stream from HBM every step regardless of batch, so tok/s scales
-    # nearly linearly with B until the KV cache saturates memory
-    dt = time_decode(B)
-    # the timed window is one full rollout: prefill over P prompt tokens + N decode
-    # steps; tok/s counts NEW tokens (operational rollout rate), MFU counts ALL
-    # FLOPs spent in the window (prefill + decode)
-    rollout_flops = B * (P * fwd_flops_tok(P // 2) + N * fwd_flops_tok(P + N // 2))
+    # nearly linearly with B until the KV cache saturates memory. tok/s counts
+    # NEW tokens (operational rollout rate); MFU counts ALL FLOPs in the window
+    # (prefill + decode).
+    dt = _time_decode(jax, trunk, trunk_params, B, P, N, reps)
     out["gpt2_rollout_new_tok_s"] = round(B * N / dt, 1)
-    out["gpt2_rollout_mfu"] = round(rollout_flops / (dt * peak), 4)
+    out["gpt2_rollout_mfu"] = round(_rollout_flops(fwd_flops_tok, B, P, N) / (dt * peak), 4)
     out["gpt2_rollout_batch"] = B
     # HBM roofline for the decode loop: every step reads all params plus the
     # mean-context KV slice; the bound is what zero-overhead decode would sustain
-    kv_step_bytes = (
-        2 * config.num_layers * config.kv_heads * config.dim_per_head
-        * (P + N // 2) * B * dtype_bytes
-    )
-    bound_tok_s = bw / (param_bytes + kv_step_bytes) * B
+    kv_bytes = _kv_step_bytes(config, B, P, N, dtype_bytes)
+    bound_tok_s = bw / (param_bytes + kv_bytes) * B
     out["gpt2_rollout_bw_bound_tok_s"] = round(bound_tok_s, 1)
     out["gpt2_rollout_frac_of_bw_bound"] = round(out["gpt2_rollout_new_tok_s"] / bound_tok_s, 4)
     if not on_cpu:
-        dt32 = time_decode(32)
+        dt32 = _time_decode(jax, trunk, trunk_params, 32, P, N, reps)
         out["gpt2_rollout_new_tok_s_b32"] = round(32 * N / dt32, 1)
         # int8 KV cache: at wide batch the KV cache dominates decode HBM traffic,
         # so halving its bytes raises the roofline (TransformerConfig.kv_cache_quant)
         qtrunk = TransformerLM(config.replace(kv_cache_quant=True))
-        dt_q = time_decode(B, use_trunk=qtrunk)
+        dt_q = _time_decode(jax, qtrunk, trunk_params, B, P, N, reps)
         out["gpt2_rollout_new_tok_s_int8kv"] = round(B * N / dt_q, 1)
-        # int8 values (1 byte/elt) + one f32 scale per dim_per_head-element row
-        kv_elems = kv_step_bytes // dtype_bytes
-        kv_q_bytes = kv_elems + kv_elems * 4 // config.dim_per_head
+        kv_q_bytes = _kv_step_bytes(config, B, P, N, None)  # int8 layout
         out["gpt2_rollout_bw_bound_tok_s_int8kv"] = round(bw / (param_bytes + kv_q_bytes) * B, 1)
-    B = 32 if not on_cpu else B  # train leg keeps its round-2 shape for comparability
 
-    # PPO train step: fwd+bwd over [B, P+R]
-    method = PPOConfig()
-    R = N
-    seq = jnp.asarray(rng.integers(1, V, (B, P + R)), jnp.int32)
-    full_mask = jnp.ones((B, P + R), jnp.int32)
-    old_lp = jnp.asarray(rng.normal(size=(B, R)), jnp.float32)
-    old_v = jnp.asarray(rng.normal(size=(B, R)), jnp.float32)
-    rew = jnp.asarray(rng.normal(size=(B, R)), jnp.float32)
-    r_mask = jnp.ones((B, R), jnp.int32)
-    tx = optax.adamw(1e-5)
-    opt_state = jax.jit(tx.init)(params)
-
-    def loss_fn(p):
-        logits, values_pred, _, _ = module.apply({"params": p}, seq, full_mask)
-        logprobs = logprobs_of_labels(logits[:, :-1], seq[:, 1:])
-        start = P - 1
-        logprobs = logprobs[:, start : start + R]
-        values_pred = values_pred[:, start : start + R].astype(jnp.float32)
-        adv, ret = method.get_advantages_and_returns(old_v, rew, r_mask)
-        loss, _ = method.loss(logprobs, values_pred, old_lp, old_v, adv, ret, r_mask)
-        return loss
-
-    # donate params/opt state like the real trainer's train_step does — without
-    # donation XLA copies the full param tree every step
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(p, s):
-        grads = jax.grad(loss_fn)(p)
-        updates, s2 = tx.update(grads, s, p)
-        return optax.apply_updates(p, updates), s2
-
-    params, opt_state = train_step(params, opt_state)
-    jax.block_until_ready(params)  # compile
-    steps = 1 if on_cpu else 5
-    t0 = time.time()
-    for _ in range(steps):
-        params, opt_state = train_step(params, opt_state)
-    jax.block_until_ready(params)
-    dt = (time.time() - t0) / steps
-    train_tok_s = B * (P + R) / dt
+    # PPO train step: fwd+bwd over [B, P+R]; round-2 shapes for comparability
+    Bt = B if on_cpu else 32
+    dt, *_ = _time_ppo_train_step(
+        jax, module, params, optax.adamw(1e-5), Bt, P, N, steps=1 if on_cpu else 5
+    )
+    train_tok_s = Bt * (P + N) / dt
     out["gpt2_train_tok_s"] = round(train_tok_s, 1)
-    out["gpt2_train_mfu"] = round(train_tok_s * 3 * fwd_flops_tok((P + R) // 2) / peak, 4)
+    out["gpt2_train_mfu"] = round(train_tok_s * 3 * fwd_flops_tok((P + N) // 2) / peak, 4)
     out["gpt2_attention_impl"] = impl
     return out
 
@@ -223,15 +256,11 @@ def _big_perf(jax):
     reference envelope ~20B across a node, README.md:7)."""
     import jax.numpy as jnp
     import numpy as np
-    import optax
 
-    from trlx_tpu.methods.ppo import PPOConfig
     from trlx_tpu.models.policy import CausalLMWithValueHead
     from trlx_tpu.models.presets import PRESETS
     from trlx_tpu.models.transformer import TransformerLM
-    from trlx_tpu.ops.generation import generate
     from trlx_tpu.ops.quantized_adam import adamw_8bit
-    from trlx_tpu.utils.modeling import logprobs_of_labels
 
     out = {}
     config = PRESETS["gpt2"].replace(
@@ -240,15 +269,13 @@ def _big_perf(jax):
         compute_dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
         attention_impl="flash", scan_layers=True, remat="nothing_saveable",
     )
-    d, L, V = config.hidden_size, config.num_layers, config.vocab_size
-    fwd_flops_tok = lambda ctx: L * (24 * d * d + 4 * ctx * d) + 2 * d * V
+    fwd_flops_tok = _fwd_flops_tok_fn(config)
     kind = jax.devices()[0].device_kind
     peak, bw = _peak_flops(kind), _peak_bw(kind)
 
     trunk = TransformerLM(config)
     module = CausalLMWithValueHead(config)
-    rng = np.random.default_rng(0)
-    init_ids = jnp.asarray(rng.integers(1, V, (1, 8)), jnp.int32)
+    init_ids = jnp.asarray(np.random.default_rng(0).integers(1, config.vocab_size, (1, 8)), jnp.int32)
     # init directly on device in bf16 (a host round-trip of 3GB is pointless)
     params = jax.jit(module.init)(
         jax.random.PRNGKey(0), init_ids, jnp.ones((1, 8), jnp.int32)
@@ -257,76 +284,20 @@ def _big_perf(jax):
     n_params = sum(x.size for x in jax.tree.leaves(params["transformer"]))
     out["xl_params_m"] = round(n_params / 1e6, 1)
 
-    def step(p, t_ids, t_mask, positions, cache):
-        logits, hidden, _, cache = trunk.apply({"params": p}, t_ids, t_mask, positions, cache)
-        return logits, hidden, cache
-
     B, P, N = 64, 128, 128
-    ids = jnp.asarray(rng.integers(1, V, (B, P)), jnp.int32)
-    mask = jnp.ones((B, P), jnp.int32)
-    decode_fn = jax.jit(
-        lambda p, i, m, r: generate(
-            step, p, lambda bb, s: trunk.init_cache(bb, s), i, m, r,
-            max_new_tokens=N, eos_token_id=None, pad_token_id=0, do_sample=True,
-        )["sequences"]
-    )
-    res = decode_fn(params["transformer"], ids, mask, jax.random.PRNGKey(1))
-    jax.block_until_ready(res)
-    t0 = time.time()
-    reps = 2
-    for i in range(reps):
-        res = decode_fn(params["transformer"], ids, mask, jax.random.PRNGKey(2 + i))
-    jax.block_until_ready(res)
-    dt = (time.time() - t0) / reps
+    dt = _time_decode(jax, trunk, params["transformer"], B, P, N, reps=2)
     out["xl_rollout_new_tok_s"] = round(B * N / dt, 1)
-    rollout_flops = B * (P * fwd_flops_tok(P // 2) + N * fwd_flops_tok(P + N // 2))
-    out["xl_rollout_mfu"] = round(rollout_flops / (dt * peak), 4)
+    out["xl_rollout_mfu"] = round(_rollout_flops(fwd_flops_tok, B, P, N) / (dt * peak), 4)
     param_bytes = n_params * 2
-    kv_step_bytes = 2 * L * config.kv_heads * config.dim_per_head * (P + N // 2) * B * 2
-    bound_tok_s = bw / (param_bytes + kv_step_bytes) * B
+    bound_tok_s = bw / (param_bytes + _kv_step_bytes(config, B, P, N, 2)) * B
     out["xl_rollout_frac_of_bw_bound"] = round(out["xl_rollout_new_tok_s"] / bound_tok_s, 4)
-    del res
 
     # PPO train step at microbatch 8, seq 256 (grad-accum scales this; per-token
     # cost is what matters), int8 moments + bf16 params + full remat + scan
-    method = PPOConfig()
     Bt, T = 8, 256
-    Pt = T // 2
-    R = T - Pt
-    seq = jnp.asarray(rng.integers(1, V, (Bt, T)), jnp.int32)
-    full_mask = jnp.ones((Bt, T), jnp.int32)
-    old_lp = jnp.asarray(rng.normal(size=(Bt, R)), jnp.float32)
-    old_v = jnp.asarray(rng.normal(size=(Bt, R)), jnp.float32)
-    rew = jnp.asarray(rng.normal(size=(Bt, R)), jnp.float32)
-    r_mask = jnp.ones((Bt, R), jnp.int32)
-    tx = adamw_8bit(1e-5)
-    opt_state = jax.jit(tx.init)(params)
-    jax.block_until_ready(opt_state)
-
-    def loss_fn(p):
-        logits, values_pred, _, _ = module.apply({"params": p}, seq, full_mask)
-        logprobs = logprobs_of_labels(logits[:, :-1], seq[:, 1:])
-        start = Pt - 1
-        logprobs = logprobs[:, start : start + R]
-        values_pred = values_pred[:, start : start + R].astype(jnp.float32)
-        adv, ret = method.get_advantages_and_returns(old_v, rew, r_mask)
-        loss, _ = method.loss(logprobs, values_pred, old_lp, old_v, adv, ret, r_mask)
-        return loss
-
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(p, s):
-        grads = jax.grad(loss_fn)(p)
-        updates, s2 = tx.update(grads, s, p)
-        return optax.apply_updates(p, updates), s2
-
-    params, opt_state = train_step(params, opt_state)
-    jax.block_until_ready(params)
-    steps = 3
-    t0 = time.time()
-    for _ in range(steps):
-        params, opt_state = train_step(params, opt_state)
-    jax.block_until_ready(params)
-    dt = (time.time() - t0) / steps
+    dt, *_ = _time_ppo_train_step(
+        jax, module, params, adamw_8bit(1e-5), Bt, T // 2, T - T // 2, steps=3
+    )
     train_tok_s = Bt * T / dt
     out["xl_train_tok_s"] = round(train_tok_s, 1)
     out["xl_train_mfu"] = round(train_tok_s * 3 * fwd_flops_tok(T // 2) / peak, 4)
