@@ -7,7 +7,7 @@ in for that stage: a DistilBERT-shaped classifier fitted (torch CPU) on the
 synthetic sentiment corpus, so the served reward is *learned* rather than a
 lexicon — exercising the full checkpoint -> server -> RPC client -> PPO chain.
 
-Usage: python examples/hh/train_tiny_rm.py [--out ckpts/tiny_rm] [--steps 300]
+Usage: python examples/hh/train_tiny_rm.py [--out ckpts/tiny_rm] [--steps 600]
 """
 
 import argparse
@@ -21,13 +21,22 @@ from examples.sentiment_task import NEGATIVE, POSITIVE, build_corpus, lexicon_se
 
 
 def build_tokenizer(tmp_vocab_path):
+    """Character-level WordPiece vocab (every ascii letter as both a start piece
+    and a ## continuation piece). Character granularity matters: the PPO policy
+    in the zero-egress examples uses a byte tokenizer, so only a char-level
+    reward model sees through to what the policy emits — a word-level vocab maps
+    novel strings to [UNK] and the served reward goes flat (no training signal)."""
     from transformers import DistilBertTokenizer
 
-    words = sorted(set(POSITIVE + NEGATIVE + "really just so quite the a movie film and".split()))
-    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + words
+    chars = list("abcdefghijklmnopqrstuvwxyz0123456789.,!?'")
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+    vocab += chars + [f"##{c}" for c in chars]
     with open(tmp_vocab_path, "w") as f:
         f.write("\n".join(vocab))
-    return DistilBertTokenizer(tmp_vocab_path)
+    # model_max_length must ride with the checkpoint: the serving pipeline's
+    # truncation=True is a no-op without it, and char-level token counts easily
+    # exceed the model's 64 position embeddings
+    return DistilBertTokenizer(tmp_vocab_path, model_max_length=64)
 
 
 def main():
@@ -36,11 +45,36 @@ def main():
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--out", default="ckpts/tiny_rm")
-    parser.add_argument("--steps", type=int, default=300)
+    parser.add_argument("--steps", type=int, default=600)
     parser.add_argument("--batch-size", type=int, default=32)
     args = parser.parse_args()
 
-    corpus = build_corpus(n=2000, seed=0)
+    # Training distribution: sentiment words embedded in RANDOM contexts, plus
+    # pure noise labeled negative. Two properties matter for a reward the policy
+    # can climb: (a) P(positive) keys on the positive WORDS, not the review
+    # templates (else any novel phrasing is out-of-distribution), and (b) noise
+    # scores low (else a random-init policy already maxes the served reward and
+    # PPO has no gradient).
+    rng0 = np.random.default_rng(7)
+    charset = list("abcdefghijklmnopqrstuvwxyz0123456789")
+
+    def noise_words(k):
+        return ["".join(rng0.choice(charset, size=rng0.integers(2, 8))) for _ in range(k)]
+
+    def synth(positive):
+        words = noise_words(int(rng0.integers(2, 6)))
+        if positive:
+            inserts = list(rng0.choice(POSITIVE, size=int(rng0.integers(1, 3))))
+        elif rng0.random() < 0.5:
+            inserts = list(rng0.choice(NEGATIVE, size=int(rng0.integers(1, 3))))
+        else:
+            inserts = []
+        for w in inserts:
+            words.insert(int(rng0.integers(len(words) + 1)), w)
+        return " ".join(words)
+
+    corpus = build_corpus(n=1000, seed=0)
+    corpus += [synth(positive=i % 2 == 0) for i in range(2000)]
     labels = [1 if lexicon_sentiment([t])[0] > 0 else 0 for t in corpus]
 
     import os
@@ -62,7 +96,7 @@ def main():
     for step in range(args.steps):
         idx = rng.integers(len(corpus), size=args.batch_size)
         enc = tok([corpus[i] for i in idx], return_tensors="pt", padding=True,
-                  truncation=True, max_length=48)
+                  truncation=True, max_length=64)
         y = torch.tensor([labels[i] for i in idx])
         out = model(**enc, labels=y)
         opt.zero_grad()
@@ -77,7 +111,7 @@ def main():
     test = build_corpus(n=200, seed=1)
     test_y = [1 if lexicon_sentiment([t])[0] > 0 else 0 for t in test]
     with torch.no_grad():
-        enc = tok(test, return_tensors="pt", padding=True, truncation=True, max_length=48)
+        enc = tok(test, return_tensors="pt", padding=True, truncation=True, max_length=64)
         pred = model(**enc).logits.argmax(-1).numpy()
     acc = float((pred == np.asarray(test_y)).mean())
     print(f"[rm] held-out acc {acc:.3f}")
