@@ -236,6 +236,18 @@ def _gpt2_perf_impl(jax, impl):
         out["gpt2_rollout_new_tok_s_int8kv"] = round(B * N / dt_q, 1)
         kv_q_bytes = _kv_step_bytes(config, B, P, N, None)  # int8 layout
         out["gpt2_rollout_bw_bound_tok_s_int8kv"] = round(bw / (param_bytes + kv_q_bytes) * B, 1)
+        # bf16 rollout param copy (train.rollout_param_dtype): decode streams
+        # every weight per token, so f32 masters pay 2x weight bandwidth
+        bf16_params = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            trunk_params,
+        )
+        bf16_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(bf16_params))
+        dt_b = _time_decode(jax, qtrunk, bf16_params, B, P, N, reps)
+        out["gpt2_rollout_new_tok_s_bf16params_int8kv"] = round(B * N / dt_b, 1)
+        out["gpt2_rollout_bw_bound_tok_s_bf16params_int8kv"] = round(
+            bw / (bf16_bytes + kv_q_bytes) * B, 1
+        )
 
     # PPO train step: fwd+bwd over [B, P+R]; round-2 shapes for comparability
     Bt = B if on_cpu else 32

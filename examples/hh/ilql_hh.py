@@ -1,0 +1,69 @@
+"""Offline ILQL on helpful/harmless dialogue pairs (parity:
+`/root/reference/examples/hh/ilql_hh.py`): (prompt, chosen) scored +1 and
+(prompt, rejected) scored -1, learned entirely offline, with advantage-shaped
+decode at eval (gen_kwargs beta sweep like the reference's beta=[1, 4]).
+
+Offline degradation: without the HH dataset this runs the same wiring on the
+synthetic dialogue task from ppo_hh (chosen = helpful answer, rejected = an
+unhelpful lexicon-negative one)."""
+
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+import trlx_tpu
+from examples.hh.ppo_hh import CHOSEN, PROMPTS, REJECTED
+from examples.sentiment_task import TINY_MODEL_OVERRIDES, lexicon_sentiment
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_ilql_config
+
+
+def build_config() -> TRLConfig:
+    config = default_ilql_config()
+    config = config.evolve(
+        train={
+            "seq_length": 96, "batch_size": 16, "total_steps": 1000,
+            "eval_interval": 100, "checkpoint_interval": 100000,
+            "checkpoint_dir": "ckpts/ilql_hh", "tracker": "jsonl",
+        },
+        method={"tau": 0.6, "gamma": 0.99, "cql_scale": 0.1, "awac_scale": 1.0,
+                "steps_for_target_q_sync": 1, "two_qs": True,
+                "gen_kwargs": {"max_new_tokens": 32, "top_k": 20, "beta": [1, 4],
+                               "temperature": 1.0}},
+    )
+    model_path = os.environ.get("HH_MODEL", "gpt2")
+    config.model.model_path = model_path
+    if not os.path.isdir(model_path):
+        config.model.model_overrides = dict(TINY_MODEL_OVERRIDES)
+        config.tokenizer.tokenizer_path = "bytes"
+    else:
+        config.tokenizer.tokenizer_path = model_path
+    return config
+
+
+def main(hparams={}):
+    config = TRLConfig.update(build_config().to_dict(), hparams)
+    # dialogue pairs with binary preference rewards (reference preprocess():
+    # prompt_output = [[prompt, chosen], [prompt, rejected]], reward = [1, -1])
+    samples = []
+    rewards = []
+    for prompt, chosen, rejected in zip(PROMPTS, CHOSEN, REJECTED):
+        samples += [[prompt, chosen], [prompt, rejected]]
+        rewards += [1.0, -1.0]
+    samples, rewards = samples * 16, rewards * 16
+
+    trlx_tpu.train(
+        samples=samples,
+        rewards=rewards,
+        eval_prompts=PROMPTS,
+        metric_fn=lambda samples, **kw: {"helpfulness": lexicon_sentiment(samples)},
+        config=config,
+        stop_sequences=["Human:", "human:"],
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})

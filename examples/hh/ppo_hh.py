@@ -34,6 +34,12 @@ CHOSEN = [
     " Close unused programs and consider more memory.",
     " Keep a regular schedule and avoid screens late.",
 ]
+REJECTED = [  # unhelpful/dismissive counterparts (ilql_hh / reward-model pairs)
+    " I hate baking and this is a waste of time.",
+    " Just give up, piano is terrible and boring.",
+    " Bad luck. Buy a new one, that one is junk.",
+    " No idea. Sleep is a mess for everyone anyway.",
+]
 
 
 def build_config() -> TRLConfig:

@@ -1,0 +1,54 @@
+"""SFT on the chosen responses of helpful/harmless dialogues (parity:
+`/root/reference/examples/hh/sft_hh.py`): supervised fine-tuning on
+prompt+chosen, with the reward model (or its lexicon stand-in) as the eval
+metric. The usual first stage before ppo_hh/ilql_hh."""
+
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+import trlx_tpu
+from examples.hh.ppo_hh import CHOSEN, PROMPTS
+from examples.sentiment_task import TINY_MODEL_OVERRIDES, lexicon_sentiment
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_sft_config
+
+
+def build_config() -> TRLConfig:
+    config = default_sft_config()
+    config = config.evolve(
+        train={
+            "seq_length": 96, "batch_size": 16, "total_steps": 600,
+            "eval_interval": 100, "checkpoint_interval": 100000,
+            "checkpoint_dir": "ckpts/sft_hh", "tracker": "jsonl",
+        },
+        method={"gen_kwargs": {"max_new_tokens": 32, "top_k": 20, "top_p": 1.0,
+                               "do_sample": True}},
+    )
+    model_path = os.environ.get("HH_MODEL", "gpt2")
+    config.model.model_path = model_path
+    if not os.path.isdir(model_path):
+        config.model.model_overrides = dict(TINY_MODEL_OVERRIDES)
+        config.tokenizer.tokenizer_path = "bytes"
+    else:
+        config.tokenizer.tokenizer_path = model_path
+    return config
+
+
+def main(hparams={}):
+    config = TRLConfig.update(build_config().to_dict(), hparams)
+    samples = [p + c for p, c in zip(PROMPTS, CHOSEN)] * 32
+    trlx_tpu.train(
+        samples=samples,
+        eval_prompts=PROMPTS,
+        metric_fn=lambda samples, **kw: {"reward": lexicon_sentiment(samples)},
+        config=config,
+        stop_sequences=["Human:", "human:", "Assistant:", "assistant:"],
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})

@@ -28,6 +28,8 @@ MODULES = [
     "examples.simulacra",
     "examples.sentiment_task",
     "examples.hh.ppo_hh",
+    "examples.hh.ilql_hh",
+    "examples.hh.sft_hh",
     "examples.hh.reward_client",
     "examples.hh.train_tiny_rm",
     "examples.randomwalks.ppo_randomwalks",

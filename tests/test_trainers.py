@@ -403,3 +403,61 @@ def test_summarize_rlhf_three_stage_chain(tmp_path):
     assert trainer.iter_count >= 4  # PPO ran from the SFT checkpoint
     logs = list((tmp_path / "ppo" / "logs").glob("*.jsonl"))
     assert logs, f"no jsonl tracker output under {tmp_path}/ppo/logs"
+
+
+@pytest.mark.slow
+def test_ppo_rollout_param_dtype(tmp_path):
+    """train.rollout_param_dtype: generation uses a cached bf16 copy of the
+    params (decode streams every weight per token; f32 masters double rollout
+    HBM traffic), invalidated after each optimizer step; masters stay f32."""
+    import jax.numpy as jnp
+
+    config = TRLConfig(
+        method=PPOConfig(
+            num_rollouts=8, chunk_size=4, ppo_epochs=1, init_kl_coef=0.01,
+            target=None, gen_kwargs=dict(max_new_tokens=6, do_sample=True, top_k=0, top_p=1.0),
+        ),
+        **base_kwargs(tmp_path, "PPOTrainer"),
+    )
+    config.train.rollout_param_dtype = "bfloat16"
+    trainer = trlx_tpu.train(
+        reward_fn=dog_reward,
+        prompts=["ab", "cd ef", "gh", "a b c"] * 2,
+        eval_prompts=["ab", "cd"],
+        config=config,
+    )
+    assert trainer.iter_count >= 3
+    # masters stay full precision; the rollout copy is bf16 and freshly cast
+    import jax
+
+    master_dtypes = {x.dtype for x in jax.tree.leaves(trainer.params) if jnp.issubdtype(x.dtype, jnp.floating)}
+    assert jnp.bfloat16 not in master_dtypes
+    gp = trainer.generation_params()
+    gen_dtypes = {x.dtype for x in jax.tree.leaves(gp) if jnp.issubdtype(x.dtype, jnp.floating)}
+    assert gen_dtypes == {jnp.dtype(jnp.bfloat16)}
+    trainer._rollout_params = None  # invalidation path: re-cast produces a fresh tree
+    assert trainer.generation_params() is not gp
+
+
+@pytest.mark.slow
+def test_ilql_beta_sweep_end_to_end(tmp_path):
+    """List-valued ILQL beta (reference ilql_hh gen_kwargs beta=[1, 4]): eval
+    sweeps the advantage-shaping strength, each value compiled with its own
+    logits processor; rollout/default beta is the first entry."""
+    config = TRLConfig(
+        method=ILQLConfig(
+            steps_for_target_q_sync=2, two_qs=True,
+            gen_kwargs=dict(max_new_tokens=4, top_k=4, beta=[1.0, 4.0], temperature=1.0),
+        ),
+        **base_kwargs(tmp_path, "ILQLTrainer"),
+    )
+    samples = [["ab", "cd"], ["ef", "gh"], ["a", "bc"], ["de", "fg"]] * 2
+    rewards = [1.0, 0.5, -0.5, 0.25] * 2
+    trainer = trlx_tpu.train(
+        samples=samples, rewards=rewards, eval_prompts=["ab", "ef"], config=config
+    )
+    assert trainer.iter_count >= 3
+    assert trainer.ilql_beta == 1.0
+    # one compiled generate per swept beta value
+    betas = {dict(k[-1]).get("beta") for k in trainer._compiled_generate}
+    assert betas == {1.0, 4.0}

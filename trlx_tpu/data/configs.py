@@ -271,6 +271,16 @@ class TrainConfig:
     # the server silently desyncs the hosts' training data.
     reward_on_process_zero: bool = False
 
+    # Cast a one-time copy of the params to this dtype for GENERATION only
+    # (training keeps full-precision master weights; scoring passes use them
+    # too). Decode streams the whole param tree from HBM every token, so f32
+    # masters make rollouts pay 2x the weight bandwidth — a bf16 rollout copy
+    # recovers it. The sampled tokens come from a bf16-param policy while
+    # old_logprobs are re-scored with the masters; PPO's clipped importance
+    # ratios absorb the (tiny) mismatch, exactly as the reference's fp16
+    # autocast sampling does against its fp32 masters.
+    rollout_param_dtype: Optional[str] = None  # e.g. "bfloat16"
+
     # jax.profiler trace window (TPU equivalent of the reference's NeMo nsys knobs,
     # configs/nemo_configs/megatron_20b.yaml:128-133): traces steps
     # [profile_start_step, profile_end_step) into profile_dir.

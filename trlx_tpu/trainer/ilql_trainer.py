@@ -80,8 +80,17 @@ class ILQLTrainer(MeshRLTrainer):
         if not isinstance(config.method, ILQLConfig):
             raise ValueError("ILQLTrainer requires method=ILQLConfig")
         self.method: ILQLConfig = config.method
-        # `beta` shapes decode logits; it is not a generation-engine kwarg
-        self.ilql_beta = float(self.generate_kwargs.pop("beta", 1.0))
+        # `beta` shapes decode logits; it is not a generation-engine kwarg. A
+        # list (reference ilql_hh gen_kwargs beta=[1, 4]) stays in generate_kwargs
+        # so evaluate() sweeps it; pop_gen_processor_kwargs routes the per-call
+        # value to the logits processor. Default/rollout beta = first entry.
+        beta = self.generate_kwargs.get("beta", 1.0)
+        if isinstance(beta, (list, tuple)):
+            # normalize to list: evaluate()'s sweep detection matches lists only
+            self.generate_kwargs["beta"] = list(beta)
+            self.ilql_beta = float(beta[0])
+        else:
+            self.ilql_beta = float(self.generate_kwargs.pop("beta", 1.0))
         # optional [V, V] next-token transition mask (parity: reference trainers'
         # logit_mask kwarg used by randomwalks; masks invalid successor tokens)
         self.logit_mask = None if logit_mask is None else np.asarray(logit_mask, bool)
@@ -191,11 +200,19 @@ class ILQLTrainer(MeshRLTrainer):
 
         return step, lambda b, s: trunk.init_cache(b, s)
 
-    def gen_logits_processor(self):
+    def pop_gen_processor_kwargs(self, gen_kwargs):
+        if "beta" in gen_kwargs:
+            val = gen_kwargs.pop("beta")
+            # un-swept list (e.g. rollout path): use its first entry
+            beta = float(val[0]) if isinstance(val, (list, tuple)) else float(val)
+            return {"beta": beta}
+        return {}
+
+    def gen_logits_processor(self, beta=None):
         """Perturb decode logits by beta*(minQ - V) from the target heads
         (parity: modeling_ilql.py:325-412)."""
         module = self.module
-        beta = self.ilql_beta
+        beta = self.ilql_beta if beta is None else beta
         logit_mask = None if self.logit_mask is None else jnp.asarray(self.logit_mask)
 
         def processor(params, hidden, logits, prev_tok):

@@ -108,6 +108,8 @@ class MeshRLTrainer(BaseRLTrainer):
         self.generate_kwargs = dict(getattr(config.method, "gen_kwargs", {}) or {})
         self.generate_experience_kwargs = getattr(config.method, "gen_experience_kwargs", None)
         self._compiled_generate = {}
+        self._rollout_params = None  # cached low-precision copy (rollout_param_dtype)
+        self._cast_rollout_params = None  # its jitted cast fn (built once)
 
         run_name = config.train.run_name
         if run_name is None:
@@ -277,9 +279,37 @@ class MeshRLTrainer(BaseRLTrainer):
         and init_cache_fn(batch, total_len) for the generation engine."""
         ...
 
-    def gen_logits_processor(self):
+    def gen_logits_processor(self, **kwargs):
         """Optional decode-time logits processor (ILQL advantage shaping)."""
         return None
+
+    def pop_gen_processor_kwargs(self, gen_kwargs: Dict[str, Any]) -> Dict[str, Any]:
+        """Remove and return gen_kwargs consumed by the logits processor rather
+        than the generation engine (e.g. ILQL's ``beta``); they become part of
+        the compile key so eval sweeps over them recompile per value."""
+        return {}
+
+    def generation_params(self):
+        """Params used by generate(): the masters, or (train.rollout_param_dtype)
+        a cached low-precision copy — decode streams every weight per token, so
+        f32 masters double rollout HBM traffic. The copy is invalidated after
+        each optimizer step and re-cast lazily (one cast per experience phase)."""
+        dtype_name = self.config.train.rollout_param_dtype
+        if dtype_name is None:
+            return self.params
+        if self._rollout_params is None:
+            if self._cast_rollout_params is None:
+                dtype = jnp.dtype(dtype_name)
+
+                def cast(x):
+                    return x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
+
+                # built once: a fresh jit wrapper per re-cast would re-trace the
+                # full param tree every optimizer step
+                self._cast_rollout_params = jax.jit(lambda p: jax.tree.map(cast, p))
+            with self.mesh:
+                self._rollout_params = self._cast_rollout_params(self.params)
+        return self._rollout_params
 
     def generate(self, prompts_ids: List[np.ndarray], eval_mode: bool = False, **kwargs):
         """Generate continuations for a list of ragged prompt id arrays.
@@ -295,6 +325,7 @@ class MeshRLTrainer(BaseRLTrainer):
         gen_kwargs.setdefault("eos_token_id", self.tokenizer.eos_token_id)
         gen_kwargs.setdefault("pad_token_id", self.tokenizer.pad_token_id)
         max_new = int(gen_kwargs.pop("max_new_tokens", 16))
+        proc_kwargs = self.pop_gen_processor_kwargs(gen_kwargs)
 
         max_len = max(len(p) for p in prompts_ids)
         buckets = [2 ** i for i in range(3, 14)]
@@ -302,7 +333,10 @@ class MeshRLTrainer(BaseRLTrainer):
         ids, mask = left_pad_batch(prompts_ids, gen_kwargs["pad_token_id"], P)
 
         is_seq2seq = getattr(self, "is_seq2seq", False)
-        key = (ids.shape, max_new, is_seq2seq, tuple(sorted(gen_kwargs.items())))
+        key = (
+            ids.shape, max_new, is_seq2seq,
+            tuple(sorted(gen_kwargs.items())), tuple(sorted(proc_kwargs.items())),
+        )
         if key not in self._compiled_generate:
             if is_seq2seq:
                 fns = self.seq2seq_gen_fns()
@@ -311,7 +345,7 @@ class MeshRLTrainer(BaseRLTrainer):
                     fns["encode"], fns["cross_kv"], fns["decode"], fns["init_cache"],
                     max_new_tokens=max_new,
                     decoder_start_token_id=self.decoder_start_token_id,
-                    logits_processor=self.gen_logits_processor(),
+                    logits_processor=self.gen_logits_processor(**proc_kwargs),
                     **gen_kwargs,
                 )
                 # outputs replicated: every host must address the full result
@@ -327,7 +361,7 @@ class MeshRLTrainer(BaseRLTrainer):
                     step_fn,
                     init_cache_fn=init_cache_fn,
                     max_new_tokens=max_new,
-                    logits_processor=self.gen_logits_processor(),
+                    logits_processor=self.gen_logits_processor(**proc_kwargs),
                     **gen_kwargs,
                 )
                 self._compiled_generate[key] = jax.jit(
@@ -337,7 +371,9 @@ class MeshRLTrainer(BaseRLTrainer):
         self.rng, sub = jax.random.split(self.rng)
         batch = mesh_lib.put_batch(self.mesh, {"ids": ids, "mask": mask})
         with self.mesh:
-            out = self._compiled_generate[key](self.params, batch["ids"], batch["mask"], sub)
+            out = self._compiled_generate[key](
+                self.generation_params(), batch["ids"], batch["mask"], sub
+            )
         # seq2seq sequences are [decoder_start] + response: pad_len for decode() is 1
         return (
             np.asarray(jax.device_get(out["sequences"])),
@@ -553,6 +589,9 @@ class MeshRLTrainer(BaseRLTrainer):
                         jax.profiler.stop_trace()
                         profiling = False
                 self.clock.tick()  # reset: measure train_step alone
+                # drop the rollout param copy BEFORE the step: fwd+bwd+update is
+                # the peak-memory window and the copy is stale after it anyway
+                self._rollout_params = None
                 stats = self.train_step(batch)
                 stats["time/forward_backward"] = self.clock.tick()
                 self.iter_count += 1
@@ -654,6 +693,7 @@ class MeshRLTrainer(BaseRLTrainer):
         path = os.path.abspath(directory)
         ckptr = ocp.StandardCheckpointer()
         self.params = ckptr.restore(os.path.join(path, "params"), self.params)
+        self._rollout_params = None
         opt_path = os.path.join(path, "opt_state")
         if os.path.exists(opt_path) and self.config.train.save_optimizer:
             self.opt_state = ckptr.restore(opt_path, self.opt_state)
