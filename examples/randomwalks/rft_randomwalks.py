@@ -1,0 +1,54 @@
+"""RFT on randomwalks (parity: `/root/reference/examples/randomwalks/rft_randomwalks.py`):
+rejection fine-tuning against the path-optimality oracle — generate per prompt,
+keep the top score-percentile band, supervise on the survivors. Fully offline:
+same walk-pretrained tiny model as ppo_randomwalks."""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import trlx_tpu
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.methods.rft import RFTConfig
+
+from examples.randomwalks import generate_random_walks
+from examples.randomwalks.ppo_randomwalks import default_config, pretrain_on_walks
+
+
+def build_config(alphabet: str) -> TRLConfig:
+    config = default_config(alphabet)
+    d = config.to_dict()
+    d["method"] = RFTConfig(
+        n_generations_per_prompt=32,
+        start_percentile=0.9,
+        end_percentile=0.95,
+        n_improve_steps=1,
+        gen_kwargs=dict(max_new_tokens=9, top_k=0, top_p=1.0, temperature=1.0, do_sample=True),
+    ).to_dict()
+    d["train"].update(trainer="RFTTrainer", checkpoint_dir="ckpts/randomwalks_rft")
+    return TRLConfig.from_dict(d)
+
+
+def main(hparams={}):
+    metric_fn, prompts, sample_walks, _, alphabet = generate_random_walks(seed=1000)
+    config = TRLConfig.update(build_config(alphabet).to_dict(), hparams)
+    # same warm start as the reference (its CarperAI/randomwalks checkpoint is
+    # walk-pretrained; random init never emits parseable walks to filter)
+    config.model.model_path = pretrain_on_walks(
+        config, sample_walks, config.train.checkpoint_dir + "/pretrain"
+    )
+    config.model.model_overrides = None
+
+    trlx_tpu.train(
+        reward_fn=lambda samples, **kwargs: metric_fn(samples)["optimality"],
+        prompts=prompts,
+        eval_prompts=prompts,
+        metric_fn=lambda samples, **kwargs: metric_fn(samples),
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})

@@ -34,6 +34,7 @@ MODULES = [
     "examples.hh.train_tiny_rm",
     "examples.randomwalks.ppo_randomwalks",
     "examples.randomwalks.ilql_randomwalks",
+    "examples.randomwalks.rft_randomwalks",
     "examples.summarize_daily_cnn.t5_summarize_daily_cnn",
     "examples.summarize_rlhf.reward_model",
     "examples.summarize_rlhf.trlx_gptj_text_summarization",

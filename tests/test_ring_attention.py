@@ -167,3 +167,38 @@ def test_ring_backward_memory_scales_with_shard():
     # per-device scratch at n=8 must be well under the single-device footprint;
     # the dominant O(S*S/n) score tile alone predicts ~8x — allow 3x for slack
     assert t8 < t1 / 3, f"ring backward temp does not shrink with the ring: n1={t1} n8={t8}"
+
+
+def test_ring_gqa_native_heads():
+    """Grouped K/V ride the ring at native head count (no repeat): forward AND
+    grads must match full attention with repeated heads."""
+    mesh = make_mesh(data=1, fsdp=1, model=8)
+    rng = np.random.default_rng(3)
+    B, H, Hkv, S, D = 2, 4, 2, 64, 8
+    q = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, Hkv, S, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, Hkv, S, D)), jnp.float32)
+    kv_valid = jnp.asarray(rng.random((B, S)) > 0.2, jnp.int32)
+    kv_valid = kv_valid.at[:, -8:].set(1)  # keep final shard non-degenerate
+    scale = 1.0 / np.sqrt(D)
+
+    def ring_loss(q, k, v):
+        out = ring_attention(
+            q, k, v, mesh, axis_name="model", causal=True, kv_valid=kv_valid
+        )
+        return (out.astype(jnp.float32) ** 2).sum(), out
+
+    def ref_loss(q, k, v):
+        rep = H // Hkv
+        out = xla_attention(
+            q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1),
+            kv_valid, True, scale,
+        )
+        return (out.astype(jnp.float32) ** 2).sum(), out
+
+    (_, out), grads = jax.value_and_grad(ring_loss, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+    (_, ref), ref_grads = jax.value_and_grad(ref_loss, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5, rtol=1e-4)
+    for g, rg in zip(grads, ref_grads):
+        assert g.shape == rg.shape  # dk/dv at native Hkv head count
+        np.testing.assert_allclose(np.asarray(g), np.asarray(rg), atol=3e-4, rtol=1e-3)

@@ -465,15 +465,9 @@ class Attention(nn.Module):
             mesh = ambient_mesh()
             n = mesh.shape.get(MODEL_AXIS, 1) if mesh is not None else 1
             if mesh is not None and n > 1 and T % n == 0 and batch_divisible(mesh, B):
-                # ring expects full-head K/V: expand grouped heads for this path only
-                if c.kv_heads != c.num_heads:
-                    rep = c.num_heads // c.kv_heads
-                    rkh = jnp.repeat(kh, rep, axis=1)
-                    rvh = jnp.repeat(vh, rep, axis=1)
-                else:
-                    rkh, rvh = kh, vh
+                # grouped K/V ride the ring at native head count (no repeat)
                 out = ring_attention(
-                    q.transpose(0, 2, 1, 3), rkh, rvh,
+                    q.transpose(0, 2, 1, 3), kh, vh,
                     mesh, axis_name=MODEL_AXIS, causal=True, scale=scale,
                     kv_valid=kv_valid, batch_axes=BATCH_AXES,
                 ).transpose(0, 2, 1, 3).astype(c.compute_dtype)

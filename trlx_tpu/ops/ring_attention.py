@@ -60,11 +60,31 @@ def _shard_mask(causal, src, my, valid_cur, tri):
     return mask
 
 
+def _fold_q(x, Hkv):
+    """Fold grouped query heads into the row axis: [B, Hkv*rep, T, ...] ->
+    [B, Hkv, rep*T, ...] (row r*T + t ↔ query head k*rep+r at position t).
+    K/V then stay at their native Hkv heads through every einsum and ppermute —
+    no repeat, so GQA models move 1/rep of the ICI bytes per rotation."""
+    B, H, T = x.shape[:3]
+    rep = H // Hkv
+    return x.reshape((B, Hkv, rep * T) + x.shape[3:]), rep
+
+
+def _unfold_q(x, rep):
+    B, Hkv, RT = x.shape[:3]
+    T = RT // rep
+    return x.reshape((B, Hkv * rep, T) + x.shape[3:])
+
+
 def _ring_fwd_local(q_loc, k_loc, v_loc, valid_loc, *, axis_name, n, causal, scale):
     """Forward ring on local shards; returns (out, lse) with lse = m + log(l)."""
     B, H, T, D = q_loc.shape
+    Hkv = k_loc.shape[1]
+    q_loc, rep = _fold_q(q_loc, Hkv)
     my = jax.lax.axis_index(axis_name)
     tri = jnp.tril(jnp.ones((T, T), dtype=bool))
+    if rep > 1:
+        tri = jnp.tile(tri, (rep, 1))  # folded row r*T+t keeps position t's row
 
     def body(step, carry):
         k_cur, v_cur, valid_cur, m, l, acc = carry
@@ -77,16 +97,17 @@ def _ring_fwd_local(q_loc, k_loc, v_loc, valid_loc, *, axis_name, n, causal, sca
         valid_next = jax.lax.ppermute(valid_cur, axis_name, perm)
         return (k_next, v_next, valid_next, m, l, acc)
 
-    m0 = jnp.full((B, H, T, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, H, T, 1), jnp.float32)
-    acc0 = jnp.zeros((B, H, T, D), jnp.float32)
+    rows = q_loc.shape[2]
+    m0 = jnp.full((B, Hkv, rows, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, rows, 1), jnp.float32)
+    acc0 = jnp.zeros((B, Hkv, rows, D), jnp.float32)
     _, _, _, m, l, acc = jax.lax.fori_loop(
         0, n, body, (k_loc, v_loc, valid_loc, m0, l0, acc0)
     )
     safe_l = jnp.where(l == 0.0, 1.0, l)
-    out = (acc / safe_l).astype(q_loc.dtype)
-    lse = jnp.where(l > 0.0, m + jnp.log(safe_l), NEG_INF)[..., 0]  # [B,H,T]
-    return out, lse
+    out = _unfold_q((acc / safe_l), rep).astype(q_loc.dtype)
+    lse = jnp.where(l > 0.0, m + jnp.log(safe_l), NEG_INF)[..., 0]
+    return out, _unfold_q(lse, rep)  # [B,H,T]
 
 
 def _ring_bwd_local(q_loc, k_loc, v_loc, valid_loc, out_loc, lse_loc, g_loc,
@@ -94,12 +115,17 @@ def _ring_bwd_local(q_loc, k_loc, v_loc, valid_loc, out_loc, lse_loc, g_loc,
     """Backward ring: dQ accumulates locally; dK/dV accumulators travel with
     their K/V shard and arrive home after the full circle of n rotations."""
     B, H, T, D = q_loc.shape
+    Hkv = k_loc.shape[1]
+    q_loc, rep = _fold_q(q_loc, Hkv)
     my = jax.lax.axis_index(axis_name)
     tri = jnp.tril(jnp.ones((T, T), dtype=bool))
-    g32 = g_loc.astype(jnp.float32)
-    lse = lse_loc[..., None]  # [B,H,T,1]
+    if rep > 1:
+        tri = jnp.tile(tri, (rep, 1))
+    g32 = _fold_q(g_loc, Hkv)[0].astype(jnp.float32)
+    out32 = _fold_q(out_loc, Hkv)[0].astype(jnp.float32)
+    lse = _fold_q(lse_loc, Hkv)[0][..., None]  # [B,Hkv,rep*T,1]
     lse_safe = jnp.where(lse > NEG_INF / 2, lse, 0.0)
-    delta = jnp.sum(g32 * out_loc.astype(jnp.float32), axis=-1, keepdims=True)
+    delta = jnp.sum(g32 * out32, axis=-1, keepdims=True)
 
     def body(step, carry):
         k_cur, v_cur, valid_cur, dk_cur, dv_cur, dq = carry
@@ -122,11 +148,16 @@ def _ring_bwd_local(q_loc, k_loc, v_loc, valid_loc, out_loc, lse_loc, g_loc,
         dv_next = jax.lax.ppermute(dv_cur, axis_name, perm)
         return (k_next, v_next, valid_next, dk_next, dv_next, dq)
 
-    zeros_kv = jnp.zeros((B, H, T, D), jnp.float32)
+    zeros_kv = jnp.zeros((B, Hkv, T, D), jnp.float32)
+    zeros_q = jnp.zeros(q_loc.shape, jnp.float32)
     _, _, _, dk, dv, dq = jax.lax.fori_loop(
-        0, n, body, (k_loc, v_loc, valid_loc, zeros_kv, zeros_kv, zeros_kv)
+        0, n, body, (k_loc, v_loc, valid_loc, zeros_kv, zeros_kv, zeros_q)
     )
-    return dq.astype(q_loc.dtype), dk.astype(k_loc.dtype), dv.astype(v_loc.dtype)
+    return (
+        _unfold_q(dq, rep).astype(q_loc.dtype),
+        dk.astype(k_loc.dtype),
+        dv.astype(v_loc.dtype),
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
@@ -191,6 +222,8 @@ def ring_attention(
 ) -> jnp.ndarray:
     """Sequence-parallel attention. q/k/v: [B, H, S, D] with S sharded over
     ``axis_name`` (batch dim sharded per ``batch_axes``, head dim replicated).
+    K/V may carry fewer (grouped) heads than q: they ride the ring at their
+    native head count (1/rep of the ICI bytes per rotation for GQA models).
     ``kv_valid`` [B, S] masks out padding keys (left-padded prompts); it rides
     the ring alongside K/V. Returns the attention output sharded like q.
 
