@@ -125,3 +125,42 @@ TINY_MODEL_OVERRIDES = dict(
     vocab_size=259, hidden_size=128, num_layers=4, num_heads=4,
     intermediate_size=512, max_position_embeddings=256,
 )
+
+
+def ensure_offline_base(base_dir: str = "ckpts/sentiment_base", steps: int = 300,
+                        seed: int = 0) -> str:
+    """SFT-pretrain the tiny byte model on the synthetic review corpus and export
+    it once (cached by directory). The reference's sentiment examples start from
+    lvwerra/gpt2-imdb — a model already fluent in the task domain. A random init
+    emits byte noise the lexicon scores 0.0 everywhere (measured: 250 PPO steps
+    dead flat), so the offline degradation needs the same shape of warm start
+    the randomwalks example uses (pretrain_on_walks)."""
+    hf_dir = os.path.join(base_dir, "sft_model")
+    if os.path.exists(os.path.join(hf_dir, "config.json")):
+        return hf_dir
+
+    import trlx_tpu
+    from trlx_tpu.data.default_configs import default_sft_config
+
+    config = default_sft_config()
+    config = config.evolve(
+        train={
+            "seq_length": 64, "batch_size": 32, "total_steps": steps,
+            "eval_interval": steps, "checkpoint_interval": 10 * steps,
+            "checkpoint_dir": os.path.join(base_dir, "sft_ckpts"), "tracker": None,
+            "seed": seed,
+        },
+    )
+    config.model.model_path = "gpt2"
+    config.model.model_overrides = dict(TINY_MODEL_OVERRIDES)
+    config.tokenizer.tokenizer_path = "bytes"
+    config.optimizer.kwargs["lr"] = 1e-3
+    trainer = trlx_tpu.train(
+        samples=build_corpus(1024, seed=seed), eval_prompts=PROMPT_STUBS[:2], config=config
+    )
+    trainer.save_pretrained(hf_dir)
+    if not os.path.exists(os.path.join(hf_dir, "config.json")):
+        # save_pretrained downgrades HF-export failures to a warning; fail HERE
+        # (and re-train next call) rather than hand PPO an unloadable model_path
+        raise RuntimeError(f"offline base export failed: no config.json in {hf_dir}")
+    return hf_dir

@@ -461,3 +461,31 @@ def test_ilql_beta_sweep_end_to_end(tmp_path):
     # one compiled generate per swept beta value
     betas = {dict(k[-1]).get("beta") for k in trainer._compiled_generate}
     assert betas == {1.0, 4.0}
+
+
+@pytest.mark.slow
+def test_ppo_resume_and_continue_training(tmp_path):
+    """Resume from a checkpoint and KEEP TRAINING on a multi-device mesh
+    (regression: orbax restore handed back single-device scalar leaves — a
+    resumed adam `count` on device 0 vs params spanning the mesh — and the
+    first post-resume train_step died with 'incompatible devices')."""
+    def cfg(total_steps, resume=None):
+        kwargs = base_kwargs(tmp_path, "PPOTrainer", total_steps=total_steps)
+        kwargs["train"].resume_from_checkpoint = resume
+        return TRLConfig(
+            method=PPOConfig(
+                num_rollouts=8, chunk_size=4, ppo_epochs=1, init_kl_coef=0.01,
+                target=None, gen_kwargs=dict(max_new_tokens=6, do_sample=True, top_k=0, top_p=1.0),
+            ),
+            **kwargs,
+        )
+
+    prompts = ["ab", "cd ef", "gh", "a b c"] * 2
+    trainer = trlx_tpu.train(reward_fn=dog_reward, prompts=prompts, config=cfg(3))
+    ckpt = str(tmp_path / "ckpts" / "checkpoint_2")
+    assert os.path.isdir(ckpt)
+
+    trainer2 = trlx_tpu.train(
+        reward_fn=dog_reward, prompts=prompts, config=cfg(5, resume=ckpt)
+    )
+    assert trainer2.iter_count >= 5  # trained PAST the restored step

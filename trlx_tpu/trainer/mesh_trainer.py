@@ -239,6 +239,22 @@ class MeshRLTrainer(BaseRLTrainer):
         self.tx = optax.multi_transform({"train": tx, "freeze": optax.set_to_zero()}, labels)
         with self.mesh:
             self.opt_state = jax.jit(self.tx.init)(self.params)
+        # Moments inherit their params' NamedShardings through jit, but
+        # input-independent leaves (adam step counts) come back committed to
+        # device 0. Replicate those over the mesh: a single-device leaf mixed
+        # with mesh-wide params makes the post-restore train step (whose compile
+        # cache is cold) reject its inputs as living on incompatible devices.
+        from jax.sharding import NamedSharding, PartitionSpec, SingleDeviceSharding
+
+        replicated = NamedSharding(self.mesh, PartitionSpec())
+        self.opt_state = jax.tree.map(
+            lambda x: (
+                jax.device_put(x, replicated)
+                if isinstance(x, jax.Array) and isinstance(x.sharding, SingleDeviceSharding)
+                else x
+            ),
+            self.opt_state,
+        )
 
     # -------------------------------------------------------------- train step
 
@@ -692,11 +708,27 @@ class MeshRLTrainer(BaseRLTrainer):
 
         path = os.path.abspath(directory)
         ckptr = ocp.StandardCheckpointer()
-        self.params = ckptr.restore(os.path.join(path, "params"), self.params)
+
+        def restore_like(sub, template):
+            """Restore + re-place every leaf on its template sharding: orbax can
+            hand back single-device arrays for scalar leaves (observed: a resumed
+            adam `count` landed on device 0 while params spanned the mesh, and
+            the next train_step died with 'incompatible devices')."""
+            restored = ckptr.restore(sub, template)
+            return jax.tree.map(
+                lambda r, t: (
+                    jax.device_put(r, t.sharding)
+                    if isinstance(t, jax.Array) and r.sharding != t.sharding
+                    else r
+                ),
+                restored, template,
+            )
+
+        self.params = restore_like(os.path.join(path, "params"), self.params)
         self._rollout_params = None
         opt_path = os.path.join(path, "opt_state")
         if os.path.exists(opt_path) and self.config.train.save_optimizer:
-            self.opt_state = ckptr.restore(opt_path, self.opt_state)
+            self.opt_state = restore_like(opt_path, self.opt_state)
         state_path = os.path.join(path, "state.json")
         if os.path.exists(state_path):
             with open(state_path) as f:
