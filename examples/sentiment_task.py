@@ -9,7 +9,7 @@ config.json), the real task is used instead — the example scripts don't change
 """
 
 import os
-from typing import List
+from typing import Dict, List
 
 import numpy as np
 
@@ -127,14 +127,10 @@ TINY_MODEL_OVERRIDES = dict(
 )
 
 
-def ensure_offline_base(base_dir: str = "ckpts/sentiment_base", steps: int = 300,
-                        seed: int = 0) -> str:
-    """SFT-pretrain the tiny byte model on the synthetic review corpus and export
-    it once (cached by directory). The reference's sentiment examples start from
-    lvwerra/gpt2-imdb — a model already fluent in the task domain. A random init
-    emits byte noise the lexicon scores 0.0 everywhere (measured: 250 PPO steps
-    dead flat), so the offline degradation needs the same shape of warm start
-    the randomwalks example uses (pretrain_on_walks)."""
+def _sft_offline_base(base_dir: str, model_path: str, arch_type: str,
+                      model_overrides: Dict, samples, steps: int, seed: int) -> str:
+    """Shared warm-start recipe: SFT the tiny model on synthetic-task samples and
+    export an HF dir once (cached by directory)."""
     hf_dir = os.path.join(base_dir, "sft_model")
     if os.path.exists(os.path.join(hf_dir, "config.json")):
         return hf_dir
@@ -151,16 +147,49 @@ def ensure_offline_base(base_dir: str = "ckpts/sentiment_base", steps: int = 300
             "seed": seed,
         },
     )
-    config.model.model_path = "gpt2"
-    config.model.model_overrides = dict(TINY_MODEL_OVERRIDES)
+    config.model.model_path = model_path
+    config.model.model_arch_type = arch_type
+    config.model.model_overrides = dict(model_overrides)
     config.tokenizer.tokenizer_path = "bytes"
     config.optimizer.kwargs["lr"] = 1e-3
-    trainer = trlx_tpu.train(
-        samples=build_corpus(1024, seed=seed), eval_prompts=PROMPT_STUBS[:2], config=config
-    )
+    trainer = trlx_tpu.train(samples=samples, eval_prompts=PROMPT_STUBS[:2], config=config)
     trainer.save_pretrained(hf_dir)
     if not os.path.exists(os.path.join(hf_dir, "config.json")):
         # save_pretrained downgrades HF-export failures to a warning; fail HERE
         # (and re-train next call) rather than hand PPO an unloadable model_path
         raise RuntimeError(f"offline base export failed: no config.json in {hf_dir}")
     return hf_dir
+
+
+def ensure_offline_base(base_dir: str = "ckpts/sentiment_base", steps: int = 300,
+                        seed: int = 0) -> str:
+    """The reference's sentiment examples start from lvwerra/gpt2-imdb — a model
+    already fluent in the task domain. A random init emits byte noise the
+    lexicon scores 0.0 everywhere (measured: 250 PPO steps dead flat), so the
+    offline degradation needs the same shape of warm start the randomwalks
+    example uses (pretrain_on_walks)."""
+    return _sft_offline_base(
+        base_dir, "gpt2", "causal", TINY_MODEL_OVERRIDES,
+        build_corpus(1024, seed=seed), steps, seed,
+    )
+
+
+def split_corpus_pairs(n: int = 1024, seed: int = 0):
+    """(stub, continuation) pairs from the synthetic corpus (seq2seq SFT data)."""
+    pairs = []
+    for review in build_corpus(n, seed=seed):
+        stub = next((s for s in PROMPT_STUBS if review.startswith(s)), None)
+        if stub:
+            pairs.append([stub, review[len(stub):]])
+    return pairs
+
+
+def ensure_offline_base_t5(model_overrides: Dict, base_dir: str = "ckpts/sentiment_base_t5",
+                           steps: int = 300, seed: int = 0) -> str:
+    """Seq2seq counterpart of :func:`ensure_offline_base`: SFT a tiny T5 on
+    (stub -> continuation) pairs (the reference's T5 examples start from
+    flan-t5 checkpoints)."""
+    return _sft_offline_base(
+        base_dir, "t5", "seq2seq", model_overrides,
+        split_corpus_pairs(1024, seed=seed), steps, seed,
+    )

@@ -489,3 +489,37 @@ def test_ppo_resume_and_continue_training(tmp_path):
         reward_fn=dog_reward, prompts=prompts, config=cfg(5, resume=ckpt)
     )
     assert trainer2.iter_count >= 5  # trained PAST the restored step
+
+
+@pytest.mark.slow
+def test_sft_seq2seq_end_to_end(tmp_path):
+    """Seq2seq SFT: teacher-forced decoder CE on (prompt, output) pairs with
+    eval generation and HF export — the supervised warm-start stage the T5 PPO
+    recipe needs (the reference's SFT trainer is causal-only)."""
+    kwargs = base_kwargs(tmp_path, "SFTTrainer")
+    kwargs["model"] = ModelConfig(
+        model_path="t5", model_arch_type="seq2seq", num_layers_unfrozen=-1,
+        model_overrides=dict(
+            vocab_size=len(ALPHABET) + 3, d_model=32, d_kv=8, d_ff=64,
+            num_layers=2, num_decoder_layers=2, num_heads=4,
+            relative_attention_num_buckets=8, decoder_start_token_id=1,
+        ),
+    )
+    config = TRLConfig(
+        method=SFTConfig(gen_kwargs=dict(max_new_tokens=4, top_k=1)),
+        **kwargs,
+    )
+    trainer = trlx_tpu.train(
+        samples=[["ab", "cd"], ["ef", "gh"], ["a b", "c d"], ["gh", "ab"]] * 2,
+        eval_prompts=["ab", "ef"],
+        config=config,
+    )
+    assert trainer.iter_count >= 3
+    out = str(tmp_path / "sft_t5")
+    trainer.save_pretrained(out)
+    assert os.path.exists(os.path.join(out, "config.json"))
+    # export round-trips through the seq2seq loader
+    from trlx_tpu.models.hf_loading import load_pretrained_seq2seq
+
+    config2, params2 = load_pretrained_seq2seq(out, overrides={})
+    assert params2 is not None

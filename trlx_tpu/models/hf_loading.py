@@ -872,3 +872,28 @@ def peft_overrides(peft_config: Optional[Dict[str, Any]]) -> Dict[str, Any]:
         return {"peft_type": "prompt",
                 "num_virtual_tokens": int(peft_config.get("num_virtual_tokens", 8))}
     raise ValueError(f"Unsupported peft_type {ptype!r} (LORA / PREFIX_TUNING / PROMPT_TUNING)")
+
+
+T5_LORA_TARGETS = ("q", "k", "v", "o", "wi", "wi_0", "wi_1", "wo")
+
+
+def t5_peft_overrides(peft_config: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Seq2seq variant of :func:`peft_overrides`: LoRA only, with T5 target-name
+    validation — a causal-style target list (q_proj/v_proj) would otherwise
+    silently build zero adapters and freeze the whole trunk."""
+    peft = peft_overrides(peft_config)
+    if not peft:
+        return {}
+    if "lora_r" not in peft:
+        raise NotImplementedError(
+            "seq2seq (T5) peft supports LORA adapters; prefix/prompt tuning "
+            "is causal-only (T5Config has no virtual-token path)"
+        )
+    peft.setdefault("lora_targets", ("q", "v"))
+    unknown = set(peft["lora_targets"]) - set(T5_LORA_TARGETS)
+    if unknown:
+        raise ValueError(
+            f"peft target_modules {sorted(unknown)} match no T5 module; "
+            f"valid T5 LoRA targets: {sorted(T5_LORA_TARGETS)}"
+        )
+    return peft

@@ -137,9 +137,14 @@ class ILQLTrainer(MeshRLTrainer):
         )
 
     def _setup_seq2seq_model(self, overrides):
-        from trlx_tpu.models.hf_loading import load_pretrained_seq2seq, merge_loaded_params
+        from trlx_tpu.models.hf_loading import (
+            load_pretrained_seq2seq,
+            merge_loaded_params,
+            t5_peft_overrides,
+        )
         from trlx_tpu.models.policy import Seq2SeqLMWithILQLHeads
 
+        overrides = {**(overrides or {}), **t5_peft_overrides(self.config.model.peft_config)}
         self.model_config, t5_params = load_pretrained_seq2seq(
             self.config.model.model_path, overrides, mesh=self.mesh
         )

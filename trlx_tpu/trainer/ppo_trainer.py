@@ -147,28 +147,11 @@ class PPOTrainer(MeshRLTrainer):
             self.ref_params = device_copy(self.params["transformer"])
 
     def _setup_seq2seq_model(self, overrides):
-        from trlx_tpu.models.hf_loading import load_pretrained_seq2seq, peft_overrides
+        from trlx_tpu.models.hf_loading import load_pretrained_seq2seq, t5_peft_overrides
         from trlx_tpu.models.policy import Seq2SeqLMWithValueHead
 
-        peft = peft_overrides(self.config.model.peft_config)
-        if peft and "lora_r" not in peft:
-            raise NotImplementedError(
-                "seq2seq (T5) peft supports LORA adapters; prefix/prompt tuning "
-                "is causal-only (T5Config has no virtual-token path)"
-            )
+        peft = t5_peft_overrides(self.config.model.peft_config)
         if peft:
-            # T5 target names are q/k/v/o + wi/wi_0/wi_1/wo; the causal default
-            # target names (q_proj/v_proj) don't exist here
-            peft.setdefault("lora_targets", ("q", "v"))
-            t5_lora_names = {"q", "k", "v", "o", "wi", "wi_0", "wi_1", "wo"}
-            unknown = set(peft["lora_targets"]) - t5_lora_names
-            if unknown:
-                # a causal-style target list would otherwise silently build zero
-                # adapters and freeze the whole trunk (policy == reference)
-                raise ValueError(
-                    f"peft target_modules {sorted(unknown)} match no T5 module; "
-                    f"valid T5 LoRA targets: {sorted(t5_lora_names)}"
-                )
             overrides = {**(overrides or {}), **peft}
 
         self.model_config, t5_params = load_pretrained_seq2seq(
