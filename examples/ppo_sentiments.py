@@ -11,6 +11,7 @@ from examples.sentiment_task import (
     PROMPT_STUBS,
     SENTIMENT_MODEL_DIR,
     TINY_MODEL_OVERRIDES,
+    apply_offline_warm_start,
     ensure_offline_base,
     hf_task_available,
     lexicon_sentiment,
@@ -56,14 +57,12 @@ def reward_fn(samples, outputs=None, **kwargs):
 
 def main(hparams={}):
     config = TRLConfig.update(build_config().to_dict(), hparams)
-    user_set_model = "model.model_path" in hparams or "model_path" in hparams.get("model", {})
-    if not hf_task_available() and not user_set_model:
+    if not hf_task_available():
         # offline stand-in for starting from gpt2-imdb: the tiny byte model
         # SFT'd on the synthetic review corpus (cached across runs). A random
         # init emits byte noise the lexicon scores 0.0 everywhere — PPO needs a
         # base that already writes words (the reference's base is pretrained).
-        config.model.model_path = ensure_offline_base()
-        config.model.model_overrides = None
+        apply_offline_warm_start(config, hparams, ensure_offline_base)
     trlx_tpu.train(
         reward_fn=reward_fn,
         prompts=PROMPT_STUBS * 4,

@@ -44,14 +44,12 @@ def build_config() -> TRLConfig:
 
 def main(hparams={}):
     config = TRLConfig.update(build_config().to_dict(), hparams)
-    user_set_model = "model.model_path" in hparams or "model_path" in hparams.get("model", {})
-    if not os.path.isdir(os.environ.get("T5_MODEL", "google/flan-t5-small")) and not user_set_model:
+    if not os.path.isdir(os.environ.get("T5_MODEL", "google/flan-t5-small")):
         # offline stand-in for flan-t5: tiny T5 SFT'd on (stub -> continuation)
         # pairs (cached); random init emits byte noise the lexicon scores 0.0
-        from examples.sentiment_task import ensure_offline_base_t5
+        from examples.sentiment_task import apply_offline_warm_start, ensure_offline_base_t5
 
-        config.model.model_path = ensure_offline_base_t5(T5_TINY)
-        config.model.model_overrides = None
+        apply_offline_warm_start(config, hparams, lambda: ensure_offline_base_t5(T5_TINY))
     trlx_tpu.train(
         reward_fn=lambda samples, outputs=None, **kw: lexicon_sentiment(outputs or samples),
         prompts=PROMPT_STUBS * 4,

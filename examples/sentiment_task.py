@@ -193,3 +193,18 @@ def ensure_offline_base_t5(model_overrides: Dict, base_dir: str = "ckpts/sentime
         base_dir, "t5", "seq2seq", model_overrides,
         split_corpus_pairs(1024, seed=seed), steps, seed,
     )
+
+
+def apply_offline_warm_start(config, hparams, ensure_fn):
+    """Swap the random-init fallback model for the cached SFT base (in place) —
+    unless the user picked a model via hparams, or the configured model_path is
+    already a real local checkpoint dir. Shared by the sentiment examples."""
+    user_set = isinstance(hparams, dict) and (
+        "model.model_path" in hparams
+        or "model_path" in (hparams.get("model") or {})
+    )
+    if user_set or os.path.isdir(config.model.model_path):
+        return config
+    config.model.model_path = ensure_fn()
+    config.model.model_overrides = None
+    return config
