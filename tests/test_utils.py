@@ -179,3 +179,30 @@ def test_lint_catches_violations(tmp_path):
         capture_output=True, text=True, cwd=REPO_ROOT,
     )
     assert "E999" in proc.stdout
+
+
+@pytest.mark.slow
+def test_bench_child_emits_driver_schema():
+    """bench.py is the driver's interface: the child must print exactly one JSON
+    line with the metric keys the driver records, on whatever platform jax
+    provides (CPU here)."""
+    import json
+    import subprocess
+    import sys
+
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"), "--child"],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT, timeout=620,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    json_lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert len(json_lines) == 1, proc.stdout[-2000:]
+    result = json.loads(json_lines[0])
+    # the perf extras are best-effort in bench.py; surface their recorded error
+    assert "gpt2_perf_error" not in result, result
+    for key in ("metric", "value", "unit", "vs_baseline", "platform",
+                "gpt2_rollout_new_tok_s", "gpt2_train_mfu", "gpt2_rollout_bw_bound_tok_s"):
+        assert key in result, (key, result)
+    assert result["metric"] == "ppo_rollout_update_samples_per_sec_per_chip"
+    assert result["value"] > 0
