@@ -207,3 +207,40 @@ def test_t5_lora_merge_matches_adapter_forward(t5_pair):
     np.testing.assert_allclose(
         np.asarray(merged_logits), np.asarray(adapter_logits), atol=2e-4, rtol=1e-4
     )
+
+
+def test_t5_int8_kv_cache_decode_matches_fp():
+    """kv_cache_quant on the T5 decoder self-attention cache: teacher-forced
+    single-token decode must track the full-precision cache up to quantization
+    noise (mirror of the causal test)."""
+    from trlx_tpu.models.t5 import T5Config, T5LM
+
+    base = T5Config(
+        vocab_size=48, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+        num_decoder_layers=2, num_heads=4, relative_attention_num_buckets=8,
+        decoder_start_token_id=0, compute_dtype=jnp.float32,
+    )
+    model = T5LM(base)
+    rng = jax.random.PRNGKey(5)
+    enc_ids = jnp.ones((1, 6), jnp.int32) * 3
+    dec_ids = jnp.asarray([[1, 5, 9, 2, 7, 4, 6, 8]], jnp.int32)
+    params = model.init(rng, enc_ids, jnp.ones_like(enc_ids), dec_ids[:, :2])["params"]
+    qmodel = T5LM(base.replace(kv_cache_quant=True))
+
+    enc_mask = jnp.ones_like(enc_ids)
+    ref_logits, _, _ = model.apply({"params": params}, enc_ids, enc_mask, dec_ids)
+
+    enc = qmodel.apply({"params": params}, enc_ids, enc_mask, method=qmodel.encode)
+    ckv = qmodel.apply({"params": params}, enc, method=qmodel.precompute_cross_kv)
+    cache = qmodel.init_cache(1, dec_ids.shape[1])
+    assert cache["k"][0].dtype == jnp.int8 and "k_scale" in cache
+    logits_steps = []
+    for t in range(dec_ids.shape[1]):
+        lt, _, cache = qmodel.apply(
+            {"params": params}, dec_ids[:, t : t + 1], enc, enc_mask, None, None,
+            cache, ckv, method=qmodel.decode,
+        )
+        logits_steps.append(lt[:, 0])
+    got = jnp.stack(logits_steps, axis=1)
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref_logits.astype(jnp.float32))))
+    assert err < 0.5, err

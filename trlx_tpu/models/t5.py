@@ -45,6 +45,8 @@ class T5Config:
     lora_r: int = 0
     lora_alpha: float = 16.0
     lora_targets: Tuple[str, ...] = ("q", "v")
+    # int8 decoder self-attention KV cache (see TransformerConfig.kv_cache_quant)
+    kv_cache_quant: bool = False
 
     @property
     def is_gated(self) -> bool:
@@ -171,10 +173,10 @@ class T5Attention(nn.Module):
             kh = k.transpose(0, 2, 1, 3)
             vh = v.transpose(0, 2, 1, 3)
             if cache is not None:
-                idx = cache["index"]
-                kh = jax.lax.dynamic_update_slice(cache["k"], kh.astype(cache["k"].dtype), (0, 0, idx, 0))
-                vh = jax.lax.dynamic_update_slice(cache["v"], vh.astype(cache["v"].dtype), (0, 0, idx, 0))
-                new_cache = {"k": kh, "v": vh}
+                from trlx_tpu.models.transformer import read_kv_cache, write_kv_cache
+
+                new_cache = write_kv_cache(cache, kh, vh, cache["index"])
+                kh, vh = read_kv_cache(new_cache, c.compute_dtype)
             else:
                 new_cache = None
         scores = jnp.einsum("bthd,bhsd->bhts", q, kh).astype(jnp.float32)
@@ -304,7 +306,8 @@ class T5LM(nn.Module):
                 branch_hidden = x
             layer_cache = None
             if cache is not None:
-                layer_cache = {"k": cache["k"][i], "v": cache["v"][i], "index": cache["index"]}
+                layer_cache = {key: cache[key][i] for key in cache if key != "index"}
+                layer_cache["index"] = cache["index"]
             ckv = None if cross_kvs is None else (cross_kvs[0][i], cross_kvs[1][i])
             x, new_lc = block(x, self_mask_bias, position_bias, enc_states, cross_mask_bias, layer_cache, ckv)
             if cache is not None:
@@ -315,10 +318,9 @@ class T5LM(nn.Module):
             # per-layer list layout (see TransformerLM.init_cache): restacking
             # would copy the whole cache every decode step
             new_cache = {
-                "k": [lc["k"] for lc in new_caches],
-                "v": [lc["v"] for lc in new_caches],
-                "index": cache["index"] + x.shape[1],
+                key: [lc[key] for lc in new_caches] for key in new_caches[0]
             }
+            new_cache["index"] = cache["index"] + x.shape[1]
         return hidden, new_cache, branch_hidden
 
     def _head(self, hidden):
@@ -465,9 +467,13 @@ class T5LM(nn.Module):
         dtype = dtype or c.compute_dtype
         # per-layer list layout: in-place single-token writes in the decode loop
         # (a stacked [L, ...] array forces full-cache slice/restack copies per step)
+        from trlx_tpu.models.transformer import kv_cache_layout
+
         shape = (batch_size, c.num_heads, max_length, c.d_kv)
-        return {
-            "k": [jnp.zeros(shape, dtype) for _ in range(c.num_decoder_layers)],
-            "v": [jnp.zeros(shape, dtype) for _ in range(c.num_decoder_layers)],
-            "index": jnp.array(0, jnp.int32),
+        per_layer = kv_cache_layout(shape, dtype, c.kv_cache_quant)
+        out = {
+            key: [jnp.zeros(shp, dt) for _ in range(c.num_decoder_layers)]
+            for key, (shp, dt) in per_layer.items()
         }
+        out["index"] = jnp.array(0, jnp.int32)
+        return out

@@ -320,6 +320,49 @@ def quantize_kv_rows(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return q, scale
 
 
+def write_kv_cache(cache: Dict[str, jnp.ndarray], kT: jnp.ndarray, vT: jnp.ndarray, idx):
+    """Append [B,H,T,D] rows at slot ``idx``; quantizes when the cache carries
+    scale planes (kv_cache_quant layout). Shared by the causal and T5 decoders —
+    the quant scheme must stay identical between them."""
+    at = (0, 0, idx, 0)
+    if "k_scale" in cache:
+        kq, ks = quantize_kv_rows(kT)
+        vq, vs = quantize_kv_rows(vT)
+        return {
+            "k": jax.lax.dynamic_update_slice(cache["k"], kq, at),
+            "v": jax.lax.dynamic_update_slice(cache["v"], vq, at),
+            "k_scale": jax.lax.dynamic_update_slice(cache["k_scale"], ks, at),
+            "v_scale": jax.lax.dynamic_update_slice(cache["v_scale"], vs, at),
+        }
+    return {
+        "k": jax.lax.dynamic_update_slice(cache["k"], kT.astype(cache["k"].dtype), at),
+        "v": jax.lax.dynamic_update_slice(cache["v"], vT.astype(cache["v"].dtype), at),
+    }
+
+
+def read_kv_cache(cache: Dict[str, jnp.ndarray], compute_dtype):
+    """(kh, vh) to attend over; int8 caches dequantize on read — XLA fuses the
+    convert+scale into the score einsum's operand stream, so HBM moves int8."""
+    if "k_scale" in cache:
+        return (
+            cache["k"].astype(compute_dtype) * cache["k_scale"].astype(compute_dtype),
+            cache["v"].astype(compute_dtype) * cache["v_scale"].astype(compute_dtype),
+        )
+    return cache["k"], cache["v"]
+
+
+def kv_cache_layout(shape: Tuple[int, ...], dtype, quant: bool) -> Dict[str, Tuple]:
+    """Per-layer cache buffers as {key: (shape, dtype)} — int8 values + one f32
+    scale per row when ``quant``."""
+    if quant:
+        return {
+            "k": (shape, jnp.int8), "v": (shape, jnp.int8),
+            "k_scale": (shape[:-1] + (1,), jnp.float32),
+            "v_scale": (shape[:-1] + (1,), jnp.float32),
+        }
+    return {"k": (shape, dtype), "v": (shape, dtype)}
+
+
 class Attention(nn.Module):
     config: TransformerConfig
 
@@ -363,27 +406,9 @@ class Attention(nn.Module):
             # [B, S, Hkv, D] layout made XLA materialize a transposed copy of
             # every layer's cache every decode step (profiled on one v5e chip:
             # ~60us copy + ~60us strided reduce per layer per step).
-            kT = k.transpose(0, 2, 1, 3)
-            vT = v.transpose(0, 2, 1, 3)
-            if "k_scale" in cache:  # int8 KV cache: quantize the new rows
-                kq, ks = quantize_kv_rows(kT)
-                vq, vs = quantize_kv_rows(vT)
-                at = (0, 0, idx, 0)
-                new_cache = {
-                    "k": jax.lax.dynamic_update_slice(cache["k"], kq, at),
-                    "v": jax.lax.dynamic_update_slice(cache["v"], vq, at),
-                    "k_scale": jax.lax.dynamic_update_slice(cache["k_scale"], ks, at),
-                    "v_scale": jax.lax.dynamic_update_slice(cache["v_scale"], vs, at),
-                }
-            else:
-                new_cache = {
-                    "k": jax.lax.dynamic_update_slice(
-                        cache["k"], kT.astype(cache["k"].dtype), (0, 0, idx, 0)
-                    ),
-                    "v": jax.lax.dynamic_update_slice(
-                        cache["v"], vT.astype(cache["v"].dtype), (0, 0, idx, 0)
-                    ),
-                }
+            new_cache = write_kv_cache(
+                cache, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3), idx
+            )
             ck, cv = new_cache["k"], new_cache["v"]
         else:
             new_cache = None
@@ -407,14 +432,8 @@ class Attention(nn.Module):
         )
         # kh/vh [B, Hkv, S, D]: the layout attention consumes (and the cache layout)
         if cache is not None and not use_flash:
-            # attend over the cache (decode step / XLA prefill); int8 caches
-            # dequantize on read — XLA fuses the convert+scale into the score
-            # einsum's operand stream, so HBM still moves int8 bytes
-            if "k_scale" in cache:
-                kh = ck.astype(c.compute_dtype) * new_cache["k_scale"].astype(c.compute_dtype)
-                vh = cv.astype(c.compute_dtype) * new_cache["v_scale"].astype(c.compute_dtype)
-            else:
-                kh, vh = ck, cv
+            # attend over the cache (decode step / XLA prefill)
+            kh, vh = read_kv_cache(new_cache, c.compute_dtype)
         else:
             kh = k.transpose(0, 2, 1, 3)
             vh = v.transpose(0, 2, 1, 3)
@@ -858,14 +877,7 @@ class TransformerLM(nn.Module):
         if c.peft_type == "prompt":
             max_length += c.num_virtual_tokens  # virtual rows live in the cache too
         shape = (batch_size, c.kv_heads, max_length, c.dim_per_head)
-        scale_shape = shape[:-1] + (1,)
-        per_layer = {"k": (shape, dtype), "v": (shape, dtype)}
-        if c.kv_cache_quant:
-            per_layer = {
-                "k": (shape, jnp.int8), "v": (shape, jnp.int8),
-                "k_scale": (scale_shape, jnp.float32),
-                "v_scale": (scale_shape, jnp.float32),
-            }
+        per_layer = kv_cache_layout(shape, dtype, c.kv_cache_quant)
         if c.stacked:
             # nn.scan layout needs one [L, ...] array per k/v
             out = {
