@@ -1,5 +1,5 @@
-"""Reward-parity evidence runner: converge PPO and ILQL on randomwalks on the
-real TPU chip and record the reward curves in PARITY_r3.json.
+"""Reward-parity evidence runner: converge the example tasks and record the
+reward curves in PARITY_r{N}.json.
 
 The reference's headline artifact is quality results — reward curves for its
 examples (`/root/reference/examples/hh/README.md` W&B runs; randomwalks is its
@@ -10,8 +10,12 @@ TPU hardware, not just unit tests and throughput.
 
 Each run executes in a subprocess (fresh jax runtime; a wedged TPU tunnel fails
 one leg, not the whole collection). Curves are parsed from the jsonl tracker.
+Results MERGE into the output file one leg at a time, so legs can run
+opportunistically (e.g. whenever the flaky TPU relay is up — see
+scripts/tpu_watch.py) and a mid-collection relay death keeps what finished.
 
-Usage: python scripts/parity_run.py [--out PARITY_r3.json]
+Usage: python scripts/parity_run.py [--out PARITY_r4.json]
+           [--legs ppo_randomwalks,ilql_randomwalks,...] [--cpu]
 """
 
 import glob
@@ -24,12 +28,15 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_leg(name, script, hparams, log_dir, timeout_s=5400):
+def run_leg(name, script, hparams, log_dir, timeout_s=5400, env=None):
     """Run one example to convergence; return (curve_dict, error|None)."""
     t0 = time.time()
+    run_env = dict(os.environ)
+    if env:
+        run_env.update(env)
     proc = subprocess.run(
         [sys.executable, script, json.dumps(hparams)],
-        cwd=REPO, capture_output=True, text=True, timeout=timeout_s,
+        cwd=REPO, capture_output=True, text=True, timeout=timeout_s, env=run_env,
     )
     err = None
     if proc.returncode != 0:
@@ -73,14 +80,19 @@ def parse_jsonl_curve(log_dir):
     return out
 
 
-def platform_info():
+def platform_info(env=None):
     code = (
         "import json, jax; d = jax.devices()[0]; "
-        "print(json.dumps({'platform': jax.default_backend(), 'device': d.device_kind}))"
+        "print(json.dumps({'platform': jax.default_backend(), 'device': d.device_kind, "
+        "'n_devices': jax.device_count()}))"
     )
+    run_env = dict(os.environ)
+    if env:
+        run_env.update(env)
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, text=True, timeout=300
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=300, env=run_env,
         )
         for line in proc.stdout.splitlines():
             if line.startswith("{"):
@@ -90,51 +102,108 @@ def platform_info():
     return {"platform": "unknown", "device": "unknown"}
 
 
+# Leg table. Targets: the randomwalks oracle tops out at 1.0 — PPO reliably
+# exceeds 0.9 (measured 0.988 on one TPU chip, round 3); ILQL is offline
+# learning from random-walk data only and plateaus ~0.82-0.85, so its bar is
+# 0.8. Sentiment legs use the lexicon reward in [-1, 1] from the SFT'd offline
+# base (practical ceiling ~0.9 causal / ~0.7 seq2seq; round-3 measured curves).
+def _legs():
+    def ck(name):
+        return os.path.join(REPO, "ckpts", name)
+
+    return {
+        "ppo_randomwalks": dict(
+            script=os.path.join(REPO, "examples", "randomwalks", "ppo_randomwalks.py"),
+            hparams={"train.total_steps": 100, "train.eval_interval": 10},
+            log_dir=ck("parity_ppo_rw"), target=0.9,
+        ),
+        "ilql_randomwalks": dict(
+            script=os.path.join(REPO, "examples", "randomwalks", "ilql_randomwalks.py"),
+            hparams={"train.total_steps": 600, "train.eval_interval": 50},
+            log_dir=ck("parity_ilql_rw"), target=0.8,
+        ),
+        "ppo_sentiments": dict(
+            script=os.path.join(REPO, "examples", "ppo_sentiments.py"),
+            hparams={"train.total_steps": 500, "train.eval_interval": 50},
+            log_dir=ck("parity_ppo_sent"), target=0.7,
+        ),
+        "ppo_sentiments_t5": dict(
+            script=os.path.join(REPO, "examples", "ppo_sentiments_t5.py"),
+            hparams={"train.total_steps": 700, "train.eval_interval": 50},
+            log_dir=ck("parity_ppo_t5"), target=0.5,
+        ),
+        "ppo_xl": dict(
+            script=os.path.join(REPO, "examples", "randomwalks", "ppo_randomwalks.py"),
+            # >=1B-parameter leg (VERDICT r3 item 5): gpt2-xl shaped policy with
+            # scan_layers + remat + bf16 + 8-bit moments; convergence bar is
+            # lower because the step budget is small at this size.
+            hparams={
+                "train.total_steps": 30, "train.eval_interval": 5,
+                "model.model_overrides": {
+                    "num_layers": 48, "hidden_size": 1600, "num_heads": 25,
+                    "scan_layers": True, "remat": True,
+                },
+                "train.mixed_precision": True, "optimizer.kind": "adamw_8bit",
+                "train.batch_size": 8, "method.chunk_size": 8,
+                "method.num_rollouts": 32,
+            },
+            log_dir=ck("parity_ppo_xl"), target=0.7, timeout_s=9000,
+        ),
+    }
+
+
+DEFAULT_LEGS = ["ppo_randomwalks", "ilql_randomwalks", "ppo_sentiments", "ppo_sentiments_t5"]
+
+
 def main():
-    out_path = os.path.join(REPO, "PARITY_r3.json")
+    out_path = os.path.join(REPO, "PARITY_r4.json")
     if "--out" in sys.argv:
         out_path = sys.argv[sys.argv.index("--out") + 1]
+    names = DEFAULT_LEGS
+    if "--legs" in sys.argv:
+        names = sys.argv[sys.argv.index("--legs") + 1].split(",")
+    env = None
+    if "--cpu" in sys.argv:
+        env = {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        }
 
-    result = {"task": "randomwalks (deterministic offline oracle: path optimality in [0,1])"}
-    result.update(platform_info())
-    # targets: oracle tops out at 1.0. PPO reliably exceeds 0.9 (measured 0.988
-    # on one TPU chip). ILQL is offline learning from random-walk data only and
-    # plateaus near ~0.82-0.85 on this task (round-1 measured curve), so its
-    # parity bar is 0.8.
-    result["target"] = {"ppo": 0.9, "ilql": 0.8}
-
-    ppo_dir = os.path.join(REPO, "ckpts", "parity_ppo_rw")
-    curve, err = run_leg(
-        "ppo", os.path.join(REPO, "examples", "randomwalks", "ppo_randomwalks.py"),
-        {
-            "train.total_steps": 100, "train.eval_interval": 10,
-            "train.checkpoint_dir": ppo_dir, "train.checkpoint_interval": 100000,
-        },
-        ppo_dir,
+    try:
+        with open(out_path) as f:
+            result = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        result = {}
+    result.setdefault(
+        "task",
+        "per-leg convergence vs offline oracles (randomwalks optimality / lexicon sentiment)",
     )
-    curve["converged"] = bool(curve.get("best", 0) >= result["target"]["ppo"])
-    if err:
-        curve["error"] = err
-    result["ppo_randomwalks"] = curve
+    plat = platform_info(env)
+    legs = _legs()
+    targets = result.setdefault("target", {})
 
-    ilql_dir = os.path.join(REPO, "ckpts", "parity_ilql_rw")
-    curve, err = run_leg(
-        "ilql", os.path.join(REPO, "examples", "randomwalks", "ilql_randomwalks.py"),
-        {
-            "train.total_steps": 600, "train.eval_interval": 50,
-            "train.checkpoint_dir": ilql_dir, "train.checkpoint_interval": 100000,
-        },
-        ilql_dir,
-    )
-    curve["converged"] = bool(curve.get("best", 0) >= result["target"]["ilql"])
-    if err:
-        curve["error"] = err
-    result["ilql_randomwalks"] = curve
+    for name in names:
+        spec = legs[name]
+        log_dir = spec["log_dir"]
+        targets[name] = spec["target"]
+        hparams = dict(spec["hparams"])
+        hparams.setdefault("train.checkpoint_dir", log_dir)
+        hparams.setdefault("train.checkpoint_interval", 100000)
+        curve, err = run_leg(
+            name, spec["script"], hparams, log_dir,
+            timeout_s=spec.get("timeout_s", 5400), env=env,
+        )
+        curve["converged"] = bool(curve.get("best", -1e9) >= spec["target"])
+        curve["platform"] = f"{plat.get('platform')} ({plat.get('device')})"
+        if err:
+            curve["error"] = err
+        result[name] = curve
+        result["measured_at"] = time.time()
+        with open(out_path, "w") as f:  # persist after EVERY leg
+            json.dump(result, f, indent=1)
+        print(json.dumps({name: {k: curve.get(k) for k in ("start", "final", "best", "converged", "error")}}))
 
-    result["measured_at"] = time.time()
-    with open(out_path, "w") as f:
-        json.dump(result, f, indent=1)
-    print(json.dumps(result))
+    print(json.dumps({"out": out_path, "legs_done": names}))
 
 
 if __name__ == "__main__":
