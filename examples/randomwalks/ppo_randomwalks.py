@@ -81,7 +81,7 @@ def pretrain_on_walks(config: TRLConfig, sample_walks, out_dir: str, steps: int 
     d["method"] = SFTConfig(gen_kwargs=dict(max_new_tokens=9, top_k=1)).to_dict()
     d["train"].update(
         trainer="SFTTrainer", total_steps=steps, epochs=100, eval_interval=steps,
-        checkpoint_interval=10 * steps, batch_size=100,
+        checkpoint_interval=10 * steps,
         checkpoint_dir=out_dir + "/sft_ckpts",
     )
     d["optimizer"]["kwargs"]["lr"] = 1e-3
@@ -98,12 +98,21 @@ def pretrain_on_walks(config: TRLConfig, sample_walks, out_dir: str, steps: int 
 def main(hparams={}):
     metric_fn, prompts, *_rest, alphabet = generate_random_walks(seed=1002)
     _, _, sample_walks, _, _ = generate_random_walks(seed=1002)
+    hparams = dict(hparams)
+    # not a TRLConfig field: SFT warm-start budget (the >=1B xl leg shrinks it)
+    pretrain_steps = int(hparams.pop("pretrain_steps", 300))
     config = TRLConfig.update(default_config(alphabet).to_dict(), hparams)
 
     out_dir = config.train.checkpoint_dir
-    hf_dir = pretrain_on_walks(config, sample_walks, out_dir)
+    hf_dir = pretrain_on_walks(config, sample_walks, out_dir, steps=pretrain_steps)
     config.model.model_path = hf_dir
-    config.model.model_overrides = None  # architecture comes from the exported config.json
+    # architecture now comes from the exported config.json; keep only the
+    # compile-layout overrides the HF config cannot record
+    layout = {
+        k: v for k, v in (config.model.model_overrides or {}).items()
+        if k in ("scan_layers", "remat")
+    }
+    config.model.model_overrides = layout or None
 
     trlx_tpu.train(
         reward_fn=lambda samples, **kwargs: metric_fn(samples)["optimality"],

@@ -130,10 +130,26 @@ TINY_MODEL_OVERRIDES = dict(
 def _sft_offline_base(base_dir: str, model_path: str, arch_type: str,
                       model_overrides: Dict, samples, steps: int, seed: int) -> str:
     """Shared warm-start recipe: SFT the tiny model on synthetic-task samples and
-    export an HF dir once (cached by directory)."""
+    export an HF dir once (cached by directory + recipe fingerprint — a stale
+    cache from different overrides/steps/seed/corpus silently poisons PPO)."""
+    import hashlib
+
     hf_dir = os.path.join(base_dir, "sft_model")
+    fp_path = os.path.join(hf_dir, "recipe_fingerprint.txt")
+    fingerprint = hashlib.sha256(
+        repr((model_path, arch_type, sorted(model_overrides.items()), steps, seed,
+              samples)).encode()
+    ).hexdigest()[:16]
     if os.path.exists(os.path.join(hf_dir, "config.json")):
-        return hf_dir
+        try:
+            with open(fp_path) as f:
+                if f.read().strip() == fingerprint:
+                    return hf_dir
+        except OSError:
+            pass
+        import shutil
+
+        shutil.rmtree(hf_dir, ignore_errors=True)  # recipe changed: re-train
 
     import trlx_tpu
     from trlx_tpu.data.default_configs import default_sft_config
@@ -158,6 +174,8 @@ def _sft_offline_base(base_dir: str, model_path: str, arch_type: str,
         # save_pretrained downgrades HF-export failures to a warning; fail HERE
         # (and re-train next call) rather than hand PPO an unloadable model_path
         raise RuntimeError(f"offline base export failed: no config.json in {hf_dir}")
+    with open(fp_path, "w") as f:
+        f.write(fingerprint)
     return hf_dir
 
 

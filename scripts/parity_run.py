@@ -134,20 +134,29 @@ def _legs():
         ),
         "ppo_xl": dict(
             script=os.path.join(REPO, "examples", "randomwalks", "ppo_randomwalks.py"),
-            # >=1B-parameter leg (VERDICT r3 item 5): gpt2-xl shaped policy with
-            # scan_layers + remat + bf16 + 8-bit moments; convergence bar is
-            # lower because the step budget is small at this size.
+            # >=1B-parameter leg (VERDICT r3 item 5): gpt2-xl shaped policy
+            # (48 x 1600, ~1.47B trunk params at the walk vocab) with
+            # scan_layers + remat + bf16 params + 8-bit Adam moments. The
+            # convergence bar is the task's PPO bar scaled to the small step
+            # budget this size affords: a clearly rising curve toward ~0.7+.
             hparams={
-                "train.total_steps": 30, "train.eval_interval": 5,
-                "model.model_overrides": {
-                    "num_layers": 48, "hidden_size": 1600, "num_heads": 25,
-                    "scan_layers": True, "remat": True,
-                },
-                "train.mixed_precision": True, "optimizer.kind": "adamw_8bit",
-                "train.batch_size": 8, "method.chunk_size": 8,
-                "method.num_rollouts": 32,
+                "pretrain_steps": 120,
+                "train.total_steps": 25, "train.eval_interval": 3,
+                "train.batch_size": 16,
+                "model.model_overrides.num_layers": 48,
+                "model.model_overrides.hidden_size": 1600,
+                "model.model_overrides.num_heads": 25,
+                "model.model_overrides.intermediate_size": 6400,
+                "model.model_overrides.scan_layers": True,
+                "model.model_overrides.remat": "nothing_saveable",
+                "optimizer.name": "adamw_8bit_bnb",
+                "mesh.param_dtype": "bfloat16",
+                "mesh.compute_dtype": "bfloat16",
+                "method.num_rollouts": 16,
+                "method.chunk_size": 16,
+                "method.ppo_epochs": 2,
             },
-            log_dir=ck("parity_ppo_xl"), target=0.7, timeout_s=9000,
+            log_dir=ck("parity_ppo_xl"), target=0.7, timeout_s=14400,
         ),
     }
 
@@ -167,6 +176,9 @@ def main():
         env = {
             "JAX_PLATFORMS": "cpu",
             "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            # replacing PYTHONPATH drops the axon sitecustomize dir: with the
+            # relay dead its register() hangs every python start otherwise
+            "PYTHONPATH": REPO,
         }
 
     try:

@@ -43,6 +43,7 @@ if mode == "sft":
 else:
     method = PPOConfig(num_rollouts=8, chunk_size=4, ppo_epochs=1, init_kl_coef=0.01,
                        target=None,
+                       overlap_reward_scoring=(mode == "ppo_rpz_overlap"),
                        gen_kwargs=dict(max_new_tokens=6, do_sample=True, top_k=0, top_p=1.0))
     trainer_name, total_steps = "PPOTrainer", 2
 config = TRLConfig(
@@ -51,7 +52,11 @@ config = TRLConfig(
                       checkpoint_interval=100000, eval_interval=100000,
                       checkpoint_dir=sys.argv[1], pipeline="PromptPipeline",
                       trainer=trainer_name, tracker=None, seed=3,
-                      reward_on_process_zero=(mode == "ppo_rpz")),
+                      # ppo_rpz: explicit on. ppo_rpz_overlap: None exercises the
+                      # auto default (multi-process => process-0 + broadcast).
+                      # ppo: explicit off (the per-host scoring path).
+                      reward_on_process_zero={"ppo_rpz": True,
+                                              "ppo_rpz_overlap": None}.get(mode, False)),
     model=ModelConfig(model_path="gpt2", num_layers_unfrozen=1 if mode == "ppo" else -1,
                       model_overrides=dict(vocab_size=len(ALPHABET)+3, hidden_size=32,
                                            num_layers=2, num_heads=2,
@@ -66,9 +71,10 @@ if mode == "sft":
     trainer = trlx_tpu.train(samples=samples, config=config)
 else:
     def reward_fn(samples, **kw):
-        if mode == "ppo_rpz":
+        if mode.startswith("ppo_rpz"):
             # the process-0 + broadcast path must NEVER call reward_fn on
-            # other hosts (the served-RM contract); crash loudly if it does
+            # other hosts (the served-RM contract); crash loudly if it does —
+            # including from the overlap worker thread (ppo_rpz_overlap)
             assert jax.process_index() == 0, "reward_fn called off process 0"
         return [float(s.count("a")) for s in samples]
     trainer = trlx_tpu.train(
@@ -93,7 +99,7 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("mode", ["sft", "ppo", "ppo_rpz"])
+@pytest.mark.parametrize("mode", ["sft", "ppo", "ppo_rpz", "ppo_rpz_overlap"])
 def test_two_process_training(tmp_path, mode):
     port = _free_port()
     script = tmp_path / "child.py"

@@ -264,12 +264,13 @@ class TrainConfig:
     rollout_logging_dir: Optional[str] = None
 
     # score with reward_fn on process 0 only and broadcast the results to every
-    # host. Default off: a pure python reward_fn is cheaper to run everywhere
-    # than to broadcast. Turn ON for served reward models (the hh RPC pattern,
-    # reference examples/hh/ppo_hh.py:108-222) — otherwise every host hits the
-    # server with identical requests (N-plicated load) and any nondeterminism in
-    # the server silently desyncs the hosts' training data.
-    reward_on_process_zero: bool = False
+    # host. None (default) = auto: ON exactly when jax.process_count() > 1 —
+    # otherwise every host hits a served reward model with identical requests
+    # (N-plicated load, the hh RPC pattern, reference examples/hh/ppo_hh.py:
+    # 108-222) and any nondeterminism in the server silently desyncs the hosts'
+    # training data. Set False explicitly for a pure-python reward_fn that is
+    # cheaper to run everywhere than to broadcast.
+    reward_on_process_zero: Optional[bool] = None
 
     # Cast a one-time copy of the params to this dtype for GENERATION only
     # (training keeps full-precision master weights; scoring passes use them

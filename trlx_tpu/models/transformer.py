@@ -344,9 +344,12 @@ def read_kv_cache(cache: Dict[str, jnp.ndarray], compute_dtype):
     """(kh, vh) to attend over; int8 caches dequantize on read — XLA fuses the
     convert+scale into the score einsum's operand stream, so HBM moves int8."""
     if "k_scale" in cache:
+        # multiply int8 values by the f32 scale at full precision, THEN cast:
+        # casting the scale to bf16 first would truncate it to 8 mantissa bits
+        # and stack avoidable error on top of the int8 quantization
         return (
-            cache["k"].astype(compute_dtype) * cache["k_scale"].astype(compute_dtype),
-            cache["v"].astype(compute_dtype) * cache["v_scale"].astype(compute_dtype),
+            (cache["k"].astype(jnp.float32) * cache["k_scale"]).astype(compute_dtype),
+            (cache["v"].astype(jnp.float32) * cache["v_scale"]).astype(compute_dtype),
         )
     return cache["k"], cache["v"]
 

@@ -26,6 +26,15 @@ logger = logging.get_logger(__name__)
 BUCKETS = [2 ** i for i in range(3, 14)]
 
 
+def _resolve_pad_id(tokenizer):
+    """pad_token_id with an eos fallback (causal-style tokenizers reused for T5
+    experiments often carry pad_token_id=None); None only if both are unset."""
+    pad = tokenizer.pad_token_id
+    if pad is None:
+        pad = getattr(tokenizer, "eos_token_id", None)
+    return pad
+
+
 class Seq2SeqSFTStore:
     """(encoder prompt ids, decoder target ids) pairs; right-padded at collate.
     The reference has no seq2seq SFT at all — its SFT trainer is causal-only —
@@ -37,6 +46,16 @@ class Seq2SeqSFTStore:
     def __init__(self, pairs, tokenizer):
         self.pairs = pairs  # list of (enc_ids, dec_ids) int arrays
         self.tokenizer = tokenizer
+        # resolve the pad id up front: causal-style tokenizers reused for T5
+        # experiments often have pad_token_id=None, which would otherwise
+        # surface as an opaque np.full TypeError at collate time
+        self.pad_id = _resolve_pad_id(tokenizer)
+        if self.pad_id is None:
+            raise ValueError(
+                "Seq2SeqSFTStore requires a tokenizer with pad_token_id (or "
+                "eos_token_id as a fallback); both are None on "
+                f"{type(tokenizer).__name__}"
+            )
 
     def __len__(self):
         return len(self.pairs)
@@ -48,7 +67,7 @@ class Seq2SeqSFTStore:
                       seed: int = 0):
         from trlx_tpu.pipeline.offline_pipeline import NumpyLoader
 
-        pad = self.tokenizer.pad_token_id
+        pad = self.pad_id
 
         def collate(items):
             enc_w = max(len(e) for e, _ in items)
@@ -270,7 +289,7 @@ class SFTTrainer(MeshRLTrainer):
         padded = {
             "input_ids": np.pad(
                 batch["input_ids"], ((0, Bp - B), (0, Teb - Te)),
-                constant_values=self.tokenizer.pad_token_id,
+                constant_values=_resolve_pad_id(self.tokenizer),
             ),
             "attention_mask": np.pad(batch["attention_mask"], ((0, Bp - B), (0, Teb - Te))),
             "labels": np.pad(
