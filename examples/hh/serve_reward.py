@@ -79,10 +79,18 @@ def main():
     )
     args = parser.parse_args()
     if args.model_dir:
-        from examples.sentiment_task import load_sentiment_scorer
+        from examples.hh.train_tiny_rm import is_ranking_rm, load_ranking_rm
 
-        SCORE_FN = load_sentiment_scorer(args.model_dir)
-        print(f"serving checkpoint {args.model_dir}", flush=True)
+        if is_ranking_rm(args.model_dir):
+            # JAX pairwise-ranking RM (scalar head; train_tiny_rm.py default
+            # mode) — serves the raw unbounded scalar so PPO has headroom
+            SCORE_FN = load_ranking_rm(args.model_dir)
+            print(f"serving ranking RM {args.model_dir}", flush=True)
+        else:
+            from examples.sentiment_task import load_sentiment_scorer
+
+            SCORE_FN = load_sentiment_scorer(args.model_dir)
+            print(f"serving checkpoint {args.model_dir}", flush=True)
     server = HTTPServer(("127.0.0.1", args.port), RewardHandler)
     print(f"reward server listening on http://127.0.0.1:{args.port}/v2/models/reward/infer", flush=True)
     server.serve_forever()

@@ -36,6 +36,43 @@ def build_config() -> TRLConfig:
     return config
 
 
+def hh_base_corpus(n_synth: int = 480, seed: int = 0):
+    """SFT corpus for the offline hh base policy: prompt+chosen, prompt+rejected,
+    and synthetic assistant replies mixing filler with BOTH sentiment polarities.
+    The base must speak the full vocabulary (positive AND negative words) so the
+    PPO stage's reward can steer it — an SFT base that only parrots the 4 chosen
+    replies gives exploration nothing to vary (round-4 flat-curve lesson)."""
+    import numpy as np
+
+    from examples.hh.ppo_hh import REJECTED
+    from examples.sentiment_task import NEGATIVE, POSITIVE
+
+    rng = np.random.default_rng(seed)
+    filler = ["try", "with", "and", "then", "also", "maybe", "the", "a", "more",
+              "less", "daily", "simple", "plan", "rest", "focus", "start", "keep"]
+    vocab = list(POSITIVE) + list(NEGATIVE) + filler * 2
+    base = [p + c for p, c in zip(PROMPTS, CHOSEN)]
+    base += [p + r for p, r in zip(PROMPTS, REJECTED)]
+    synth = []
+    for _ in range(n_synth):
+        prompt = PROMPTS[int(rng.integers(len(PROMPTS)))]
+        words = list(rng.choice(vocab, size=int(rng.integers(4, 9))))
+        synth.append(prompt + " " + " ".join(words) + ".")
+    return base * 8 + synth
+
+
+def ensure_hh_base(base_dir: str = "ckpts/hh_base_r4", steps: int = 400,
+                   seed: int = 0) -> str:
+    """Cached offline SFT base for the hh recipe (fingerprinted like the
+    sentiment warm starts); returns an HF-export dir for HH_MODEL."""
+    from examples.sentiment_task import _sft_offline_base
+
+    return _sft_offline_base(
+        base_dir, "gpt2", "causal", TINY_MODEL_OVERRIDES,
+        hh_base_corpus(seed=seed), steps, seed, seq_length=96,
+    )
+
+
 def main(hparams={}):
     config = TRLConfig.update(build_config().to_dict(), hparams)
     samples = [p + c for p, c in zip(PROMPTS, CHOSEN)] * 32

@@ -128,7 +128,8 @@ TINY_MODEL_OVERRIDES = dict(
 
 
 def _sft_offline_base(base_dir: str, model_path: str, arch_type: str,
-                      model_overrides: Dict, samples, steps: int, seed: int) -> str:
+                      model_overrides: Dict, samples, steps: int, seed: int,
+                      seq_length: int = 64) -> str:
     """Shared warm-start recipe: SFT the tiny model on synthetic-task samples and
     export an HF dir once (cached by directory + recipe fingerprint — a stale
     cache from different overrides/steps/seed/corpus silently poisons PPO)."""
@@ -138,7 +139,7 @@ def _sft_offline_base(base_dir: str, model_path: str, arch_type: str,
     fp_path = os.path.join(hf_dir, "recipe_fingerprint.txt")
     fingerprint = hashlib.sha256(
         repr((model_path, arch_type, sorted(model_overrides.items()), steps, seed,
-              samples)).encode()
+              seq_length, samples)).encode()
     ).hexdigest()[:16]
     if os.path.exists(os.path.join(hf_dir, "config.json")):
         try:
@@ -157,7 +158,7 @@ def _sft_offline_base(base_dir: str, model_path: str, arch_type: str,
     config = default_sft_config()
     config = config.evolve(
         train={
-            "seq_length": 64, "batch_size": 32, "total_steps": steps,
+            "seq_length": seq_length, "batch_size": 32, "total_steps": steps,
             "eval_interval": steps, "checkpoint_interval": 10 * steps,
             "checkpoint_dir": os.path.join(base_dir, "sft_ckpts"), "tracker": None,
             "seed": seed,

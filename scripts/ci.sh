@@ -13,7 +13,15 @@ python scripts/lint.py trlx_tpu examples tests scripts bench.py __graft_entry__.
 
 echo "== tests"
 if [[ "${1:-}" == "--slow" ]]; then
-    python -m pytest tests/ -q
+    # full suite; records the round's TESTS artifact (pass/fail counts,
+    # duration, slowest 10) so the suite status is committed evidence —
+    # including failures, so the report must be written even when pytest fails
+    ROUND_TESTS="${TESTS_ARTIFACT:-TESTS_r04.json}"
+    rc=0
+    python -m pytest tests/ -q --junit-xml=/tmp/trlx_junit.xml || rc=$?
+    python scripts/test_report.py /tmp/trlx_junit.xml "$ROUND_TESTS"
+    echo "wrote $ROUND_TESTS"
+    if [[ $rc -ne 0 ]]; then exit $rc; fi
 else
     python -m pytest tests/ -q -m "not slow"
 fi

@@ -157,6 +157,20 @@ def test_top_k_top_p_filters():
     assert int(tok[0]) == 3
 
 
+def test_fused_top_k_top_p_matches_sequential():
+    """apply_top_k_top_p (k-subset nucleus cutoff, no full-vocab sort) must keep
+    exactly the tokens the sequential top-k -> top-p composition keeps."""
+    from trlx_tpu.ops.sampling import apply_top_k_top_p
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(8, 64)).astype(np.float32) * 3)
+    for k in (1, 2, 8, 63):
+        for p in (0.1, 0.5, 0.9, 1.0):
+            fused = np.asarray(apply_top_k_top_p(logits, k, p)) > -1e8
+            seq = np.asarray(apply_top_p(apply_top_k(logits, k), p)) > -1e8
+            assert (fused == seq).all(), (k, p)
+
+
 def test_pad_to_bucket():
     assert pad_to_bucket(5, [8, 16]) == 8
     assert pad_to_bucket(9, [8, 16]) == 16

@@ -118,3 +118,56 @@ def test_reward_server_serves_real_checkpoint(tmp_path):
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+def test_reward_server_serves_ranking_rm(tmp_path):
+    """Round-4 path: the JAX pairwise-ranking RM (train_tiny_rm.py default mode)
+    saved + detected + served; scalar rewards with the chosen-delta contract."""
+    from examples.hh.reward_client import RemoteRewardClient
+    from examples.hh.train_tiny_rm import is_ranking_rm, load_ranking_rm, train_ranking_rm
+
+    rm_dir = str(tmp_path / "rank_rm")
+    train_ranking_rm(rm_dir, steps=8)  # wiring test, not convergence
+    assert is_ranking_rm(rm_dir) and not is_ranking_rm(str(tmp_path / "missing"))
+
+    # in-process load path: deterministic scalar scores
+    score_fn = load_ranking_rm(rm_dir)
+    s1 = score_fn(["good movie", "zq mw"])
+    s2 = score_fn(["good movie", "zq mw"])
+    assert len(s1) == 2 and s1 == s2
+
+    port = _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO_ROOT, "examples/hh/serve_reward.py"),
+         "--port", str(port), "--model-dir", rm_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, cwd=REPO_ROOT,
+        # the ranking RM imports jax in the server: force CPU + drop the axon
+        # sitecustomize (a dead relay otherwise hangs the server at import)
+        env={**os.environ, "PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": ""},
+    )
+    try:
+        seen = []
+        saw_rm = False
+        for _ in range(80):
+            line = proc.stdout.readline()
+            seen.append(line)
+            saw_rm |= "serving ranking RM" in line
+            if "listening" in line:
+                break
+        else:
+            raise AssertionError(f"server never came up: {seen}")
+        assert saw_rm, seen
+        client = RemoteRewardClient(f"http://127.0.0.1:{port}/v2/models/reward/infer")
+        scores = client(samples=["good movie", "zq mw"], outputs=["good movie", "zq mw"])
+        assert len(scores) == 2
+        assert scores == s1  # served scores match the in-process load path
+        # delta-vs-chosen: identical chosen text zeroes the reward exactly
+        delta = client(samples=["good movie"], outputs=["good movie"], chosen=["good movie"])
+        assert delta == [0.0]
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()

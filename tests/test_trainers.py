@@ -219,6 +219,38 @@ def test_ppo_overlap_reward_scoring(tmp_path):
 
 
 @pytest.mark.slow
+def test_ppo_offload_ref(tmp_path):
+    """ModelConfig.offload_ref: the full frozen reference lives in host memory,
+    streams in for scoring, and is released before the update phase — training
+    must run green and the device view must equal the held host copy."""
+    import jax
+
+    config = TRLConfig(
+        method=PPOConfig(
+            num_rollouts=8, chunk_size=4, ppo_epochs=1, init_kl_coef=0.01,
+            target=None, gen_kwargs=dict(max_new_tokens=4, do_sample=True, top_k=0, top_p=1.0),
+        ),
+        **base_kwargs(tmp_path, "PPOTrainer"),
+    )
+    config.model.offload_ref = True
+    assert config.model.num_layers_unfrozen == -1  # offload needs the full-copy ref
+    trainer = trlx_tpu.train(
+        reward_fn=dog_reward, prompts=["ab", "cd ef", "gh", "a b c"] * 2,
+        eval_prompts=["ab"], config=config,
+    )
+    assert trainer.iter_count >= 3
+    assert trainer.ref_params is None and trainer._ref_host is not None
+    assert trainer._ref_dev is None  # released after the last make_experience
+    dev = trainer._ref_scoring_params()
+    host_leaves = jax.tree.leaves(jax.tree.map(np.asarray, trainer._ref_host))
+    dev_leaves = jax.tree.leaves(jax.tree.map(np.asarray, dev))
+    for h, d in zip(host_leaves, dev_leaves):
+        np.testing.assert_array_equal(h, d)
+    trainer._release_ref()
+    assert trainer._ref_dev is None
+
+
+@pytest.mark.slow
 def test_decode_stop_sequences(tmp_path):
     """Token-level stop trimming: outputs are cut at the first stop sequence with
     the reference's rstrip semantics, and output ids match the decoded string

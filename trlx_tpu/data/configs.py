@@ -114,6 +114,14 @@ class ModelConfig:
     :param model_overrides: overrides applied to the architecture config
         (e.g. ``{"n_layer": 2}``) — mainly for tests and random-init runs.
     :param init_scale: stddev scale for random init when no checkpoint exists.
+    :param offload_ref: keep the full frozen KL-reference copy in HOST memory
+        (pinned-host placement on TPU, numpy otherwise) and stream it onto the
+        device only for the rollout scoring pass. Only applies when the ref is
+        a full copy (``num_layers_unfrozen=-1``, or pipeline parallelism, which
+        forbids the hydra branch); at 7B+ on small meshes the resident HBM ref
+        copy is otherwise the binding memory constraint. The analogue of the
+        reference's NeMo CPU-pinned policy/ref swap
+        (modeling_nemo_ppo.py:228-312).
     """
 
     model_path: str = "gpt2"
@@ -122,6 +130,7 @@ class ModelConfig:
     peft_config: Optional[Dict[str, Any]] = None
     model_overrides: Optional[Dict[str, Any]] = None
     init_scale: float = 0.02
+    offload_ref: bool = False
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):

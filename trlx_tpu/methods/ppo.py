@@ -129,8 +129,10 @@ class PPOConfig(MethodConfig):
     # prompts per *generation* device batch during make_experience (defaults to
     # chunk_size). Decode is bandwidth-bound on the weights — every step streams
     # all parameters regardless of batch — so the decode batch wants to be as
-    # wide as memory allows, independently of the reward/scoring chunk (measured
-    # on one v5e chip, gpt2-124M: 3.3x new-tok/s going 32 -> 128).
+    # wide as memory allows, independently of the reward/scoring chunk. The
+    # batch-width effect is recorded per round by bench.py's
+    # gpt2_rollout_new_tok_s (B=256) vs gpt2_rollout_new_tok_s_b32 keys
+    # (BENCH_r0N.json / .bench_tpu_cache.json; docs/evidence.md).
     decode_batch_size: Optional[int] = None
 
     def kl_controller(self):

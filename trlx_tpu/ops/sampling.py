@@ -44,6 +44,33 @@ def apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
     return jnp.where(logits < cutoff, NEG_INF, logits)
 
 
+def apply_top_k_top_p(logits: jnp.ndarray, k: int, p: float) -> jnp.ndarray:
+    """Fused top-k -> top-p: the nucleus cutoff is computed on the k already-
+    sorted top-k values instead of a full-vocab sort (``lax.top_k`` is O(V)
+    selection; the sort shrinks from V to k elements — V/k less sort work per
+    decode step, e.g. 50257 -> 50 for gpt2 sampling defaults).
+
+    Equivalent to ``apply_top_p(apply_top_k(logits, k), p)`` whenever no logit
+    ties the k-th largest value (after top-k masking, softmax over the masked
+    vocab then equals softmax over the k kept values, so the cumulative-mass
+    cutoff is identical). With ties at the k-th value both paths keep every
+    tied token, but this cutoff normalizes over k values instead of k+ties, so
+    it can be at most one probability bin stricter — a measure-zero event for
+    real-valued model logits."""
+    vals = jax.lax.top_k(logits, k)[0]  # [.., k], sorted descending
+    kth = vals[..., -1:]
+    kept = jnp.where(logits < kth, NEG_INF, logits)
+    if p >= 1.0:
+        return kept
+    probs = jax.nn.softmax(vals, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = jnp.concatenate(
+        [jnp.ones_like(cum[..., :1], bool), cum[..., :-1] < p], axis=-1
+    )
+    cutoff = jnp.min(jnp.where(keep_sorted, vals, jnp.inf), axis=-1, keepdims=True)
+    return jnp.where(kept < cutoff, NEG_INF, kept)
+
+
 def sample_token(
     rng: jax.Array,
     logits: jnp.ndarray,
@@ -56,6 +83,8 @@ def sample_token(
     if not do_sample:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = apply_temperature(logits.astype(jnp.float32), temperature)
-    logits = apply_top_k(logits, top_k)
-    logits = apply_top_p(logits, top_p)
+    if 0 < top_k < logits.shape[-1]:
+        logits = apply_top_k_top_p(logits, top_k, top_p)
+    else:
+        logits = apply_top_p(logits, top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

@@ -124,6 +124,14 @@ class PPOTrainer(MeshRLTrainer):
                 f"num_layers_unfrozen={n_unfrozen} exceeds num_layers={self.model_config.num_layers}"
             )
         self.peft_base_ref = bool(self.config.model.peft_config)
+        if self.config.model.offload_ref and (self.peft_base_ref or n_unfrozen > 0):
+            # only the FULL-copy reference lives in HBM at size worth offloading;
+            # hydra/peft refs are already the cheap option — say so instead of
+            # silently ignoring the flag
+            logger.warning(
+                "offload_ref ignored: the reference is a hydra branch / disabled-"
+                "adapter view (num_layers_unfrozen > 0 or peft), not a full copy"
+            )
         if self.peft_base_ref:
             # peft mode: the trunk is frozen and only adapters train, so the KL
             # reference is the SAME params applied through a module with the
@@ -144,7 +152,11 @@ class PPOTrainer(MeshRLTrainer):
         else:
             self.branch_start = None
             self.frozen_branch_params = None
-            self.ref_params = device_copy(self.params["transformer"])
+            if self.config.model.offload_ref:
+                self._setup_ref_offload(self.params["transformer"], shardings["transformer"])
+                self.ref_params = None
+            else:
+                self.ref_params = device_copy(self.params["transformer"])
 
     def _setup_seq2seq_model(self, overrides):
         from trlx_tpu.models.hf_loading import load_pretrained_seq2seq, t5_peft_overrides
@@ -187,6 +199,13 @@ class PPOTrainer(MeshRLTrainer):
                 f"num_layers_unfrozen={n_unfrozen} exceeds "
                 f"num_decoder_layers={self.model_config.num_decoder_layers}"
             )
+        if self.config.model.offload_ref and (
+            self.peft_base_ref or 0 < n_unfrozen < self.model_config.num_decoder_layers
+        ):
+            logger.warning(
+                "offload_ref ignored: the seq2seq reference is a decoder branch /"
+                " disabled-adapter view, not a full copy"
+            )
         if self.peft_base_ref:
             # adapters-only training: the KL reference is the SAME t5 params
             # applied through a module with LoRA structurally disabled (mirrors
@@ -211,7 +230,44 @@ class PPOTrainer(MeshRLTrainer):
             # be training.
             self.branch_start = None
             self.frozen_branch_params = None
-            self.ref_params = device_copy(self.params["t5"])
+            if self.config.model.offload_ref:
+                self._setup_ref_offload(self.params["t5"], shardings["t5"])
+                self.ref_params = None
+            else:
+                self.ref_params = device_copy(self.params["t5"])
+
+    def _setup_ref_offload(self, tree, shardings):
+        """Keep the full frozen KL-reference in HOST memory (ModelConfig.offload_ref):
+        pinned-host placement where the backend supports memory kinds (TPU), host
+        numpy otherwise (single-host only — multi-host uses the pinned path). The
+        ref streams onto the device per rollout-scoring phase and is dropped for
+        the update phase, where HBM actually peaks — the reference's NeMo
+        CPU-pinned policy/ref swap (modeling_nemo_ppo.py:228-312)."""
+        self._ref_shardings = shardings
+        self._ref_dev = None
+        try:
+            host_sh = jax.tree.map(lambda s: s.with_memory_kind("pinned_host"), shardings)
+            self._ref_host = jax.device_put(tree, host_sh)
+            jax.block_until_ready(self._ref_host)
+            self._ref_host_kind = "pinned_host"
+        except Exception:
+            self._ref_host = jax.tree.map(lambda x: np.asarray(x), tree)
+            self._ref_host_kind = "numpy"
+        logger.info(f"offload_ref: frozen reference held in {self._ref_host_kind} host memory")
+
+    def _ref_scoring_params(self):
+        """Device view of the ref params for the scoring forward; materialized
+        once per rollout phase (released by :meth:`_release_ref`)."""
+        if getattr(self, "_ref_host", None) is None:
+            return self.ref_params
+        if self._ref_dev is None:
+            with self.mesh:
+                self._ref_dev = jax.device_put(self._ref_host, self._ref_shardings)
+        return self._ref_dev
+
+    def _release_ref(self):
+        """Free the device ref copy after make_experience (no-op unless offloaded)."""
+        self._ref_dev = None
 
     def trainable_path_predicate(self, path: str) -> bool:
         if getattr(self, "is_seq2seq", False):
@@ -480,6 +536,9 @@ class PPOTrainer(MeshRLTrainer):
         if self.log_rollouts:
             self.store.export_history(location=self.rollout_logging_dir, tokenizer=self.tokenizer)
         self.push_to_store(ppo_rl_elements[:num_rollouts])
+        # offloaded ref: drop the device copy before the update phase (where
+        # grads + optimizer state peak HBM); no-op otherwise
+        self._release_ref()
 
     def _score_and_store(self, chunk, scores, ppo_rl_elements, accumulated_kl, all_scores_log):
         """Normalize scores, run the jitted logprob/value/ref scoring forward, and
@@ -523,7 +582,7 @@ class PPOTrainer(MeshRLTrainer):
             )
             with self.mesh:
                 logprobs, values, ref_logprobs = score_fn(
-                    self.params, self.ref_params, self.frozen_branch_params,
+                    self.params, self._ref_scoring_params(), self.frozen_branch_params,
                     dbatch["q"], dbatch["qm"], dbatch["r"], dbatch["rm"],
                 )
         else:
@@ -532,7 +591,7 @@ class PPOTrainer(MeshRLTrainer):
             dbatch = mesh_lib.put_batch(self.mesh, {"seq": seq, "mask": mask})
             with self.mesh:
                 logprobs, values, ref_logprobs = score_fn(
-                    self.params, self.ref_params, self.frozen_branch_params,
+                    self.params, self._ref_scoring_params(), self.frozen_branch_params,
                     dbatch["seq"], dbatch["mask"],
                 )
         logprobs = np.asarray(jax.device_get(logprobs))
