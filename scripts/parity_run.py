@@ -150,12 +150,20 @@ def _legs():
                 "model.model_overrides.scan_layers": True,
                 "model.model_overrides.remat": "nothing_saveable",
                 "optimizer.name": "adamw_8bit_bnb",
+                # host-offloaded full KL reference — the memory option this
+                # model size exists to exercise (ModelConfig.offload_ref)
+                "model.offload_ref": True,
                 "mesh.param_dtype": "bfloat16",
                 "mesh.compute_dtype": "bfloat16",
                 "method.num_rollouts": 16,
                 "method.chunk_size": 16,
                 "method.ppo_epochs": 2,
             },
+            # FSDP-shard the 1.47B params/grads/moments across the virtual CPU
+            # mesh: a data-replicated layout holds 8 copies and OOMs the host
+            # (2.9GB bf16 x 8 + grads blew 125GB RAM). The single-chip TPU run
+            # keeps the default 1-device mesh.
+            hparams_cpu={"mesh.data": 1, "mesh.fsdp": 8},
             log_dir=ck("parity_ppo_xl"), target=0.7, timeout_s=14400,
         ),
     }
@@ -199,6 +207,8 @@ def main():
         log_dir = spec["log_dir"]
         targets[name] = spec["target"]
         hparams = dict(spec["hparams"])
+        if env is not None:  # --cpu: apply the leg's virtual-mesh overrides
+            hparams.update(spec.get("hparams_cpu", {}))
         hparams.setdefault("train.checkpoint_dir", log_dir)
         hparams.setdefault("train.checkpoint_interval", 100000)
         curve, err = run_leg(
