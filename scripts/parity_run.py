@@ -159,11 +159,27 @@ def _legs():
                 "method.chunk_size": 16,
                 "method.ppo_epochs": 2,
             },
-            # FSDP-shard the 1.47B params/grads/moments across the virtual CPU
-            # mesh: a data-replicated layout holds 8 copies and OOMs the host
-            # (2.9GB bf16 x 8 + grads blew 125GB RAM). The single-chip TPU run
-            # keeps the default 1-device mesh.
-            hparams_cpu={"mesh.data": 1, "mesh.fsdp": 8},
+            # CPU fallback runs a SINGLE virtual device: 8-way layouts either
+            # hold 8 param copies (data: OOM'd the 125GB host) or run
+            # collectives inside the scanned stack, which XLA CPU's
+            # InProcessCommunicator hard-aborts after a 40s rendezvous skew —
+            # one physical core cannot land 8 heavy threads inside the window.
+            # Sharded-at-scale evidence stays with dryrun_multichip + the TPU
+            # queue variant of this leg (single chip, default mesh).
+            # CPU overlay (scripts/xl_microbench.py is the committed evidence):
+            # f32 compute (XLA CPU emulates bf16 matmuls 5.3x slower: 1.78s vs
+            # 9.36s for 1600x6400x1600) and plain adamw (the 8-bit update's
+            # per-element log/exp quantization costs 429s/step on one core vs
+            # 44s for the whole fwd+bwd — trivial on the TPU VPU, prohibitive
+            # here). bf16 param storage, scan, remat and offload_ref — the
+            # memory machinery — stay on. Step budget trimmed to what ~85s/step
+            # affords; the full config runs on the TPU queue variant.
+            hparams_cpu={"mesh.data": 1, "mesh.fsdp": 1,
+                         "mesh.compute_dtype": "float32",
+                         "optimizer.name": "adamw",
+                         "pretrain_steps": 80,
+                         "train.total_steps": 20},
+            env_cpu={"XLA_FLAGS": "--xla_force_host_platform_device_count=1"},
             log_dir=ck("parity_ppo_xl"), target=0.7, timeout_s=14400,
         ),
     }
@@ -207,13 +223,15 @@ def main():
         log_dir = spec["log_dir"]
         targets[name] = spec["target"]
         hparams = dict(spec["hparams"])
+        leg_env = env
         if env is not None:  # --cpu: apply the leg's virtual-mesh overrides
             hparams.update(spec.get("hparams_cpu", {}))
+            leg_env = {**env, **spec.get("env_cpu", {})}
         hparams.setdefault("train.checkpoint_dir", log_dir)
         hparams.setdefault("train.checkpoint_interval", 100000)
         curve, err = run_leg(
             name, spec["script"], hparams, log_dir,
-            timeout_s=spec.get("timeout_s", 5400), env=env,
+            timeout_s=spec.get("timeout_s", 5400), env=leg_env,
         )
         curve["converged"] = bool(curve.get("best", -1e9) >= spec["target"])
         curve["platform"] = f"{plat.get('platform')} ({plat.get('device')})"

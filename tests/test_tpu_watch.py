@@ -1,0 +1,71 @@
+"""Relay-watcher mechanics (scripts/tpu_watch.py): job verification, retry
+accounting, and queue draining — the round's TPU-evidence capture must not
+bitrot while the relay is down (it can revive at any time)."""
+
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+
+import tpu_watch  # noqa: E402
+
+
+def _patch_paths(monkeypatch, tmp_path):
+    import bench
+
+    monkeypatch.setattr(tpu_watch, "QUEUE", str(tmp_path / "queue.json"))
+    monkeypatch.setattr(tpu_watch, "STATE", str(tmp_path / "state.json"))
+    monkeypatch.setattr(tpu_watch, "STOP", str(tmp_path / "stop"))
+    # keep test job_start/job_end events out of the round's real retry log
+    monkeypatch.setattr(bench, "RETRY_LOG", str(tmp_path / "retry.jsonl"))
+
+
+def test_verify_artifact_rejects_stale_and_wrong_content(tmp_path):
+    art = tmp_path / "a.json"
+    art.write_text('{"platform": "tpu"}')
+    job = {"artifact": str(art), "verify_contains": '"platform": "tpu"'}
+    # fresh + matching content
+    assert tpu_watch.verify_artifact(job, started_at=0.0)
+    # stale: written before the job started (e.g. last round's capture)
+    assert not tpu_watch.verify_artifact(job, started_at=time.time() + 60)
+    # fresh but wrong content (CPU fallback is not evidence)
+    art.write_text('{"platform": "cpu"}')
+    assert not tpu_watch.verify_artifact(job, started_at=0.0)
+    # missing artifact
+    assert not tpu_watch.verify_artifact({"artifact": str(tmp_path / "nope")}, 0.0)
+    # no artifact declared -> rc alone decides
+    assert tpu_watch.verify_artifact({}, started_at=time.time())
+
+
+def test_run_job_success_and_retry_cap(tmp_path, monkeypatch):
+    _patch_paths(monkeypatch, tmp_path)
+    art = tmp_path / "out.json"
+    good = {
+        "name": "good",
+        "argv": [sys.executable, "-c",
+                 f"open({str(art)!r}, 'w').write('{{\"platform\": \"tpu\"}}')"],
+        "artifact": str(art),
+        "verify_contains": "tpu",
+        "timeout_s": 60,
+    }
+    bad = {"name": "bad", "argv": [sys.executable, "-c", "raise SystemExit(3)"],
+           "timeout_s": 60}
+    (tmp_path / "queue.json").write_text(json.dumps({"jobs": [good, bad]}))
+
+    state = tpu_watch.load_state()
+    assert [j["name"] for j in tpu_watch.pending_jobs(state)] == ["good", "bad"]
+
+    assert tpu_watch.run_job(good, state)
+    state = tpu_watch.load_state()
+    assert "good" in state["done"]
+    assert [j["name"] for j in tpu_watch.pending_jobs(state)] == ["bad"]
+
+    # failing job: retried up to the cap, then dropped from pending
+    for _ in range(tpu_watch.MAX_ATTEMPTS_PER_JOB):
+        assert not tpu_watch.run_job(bad, tpu_watch.load_state())
+    state = tpu_watch.load_state()
+    assert state["attempts"]["bad"] == tpu_watch.MAX_ATTEMPTS_PER_JOB
+    assert tpu_watch.pending_jobs(state) == []
