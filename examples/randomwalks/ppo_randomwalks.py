@@ -70,7 +70,8 @@ def default_config(alphabet: str) -> TRLConfig:
     )
 
 
-def pretrain_on_walks(config: TRLConfig, sample_walks, out_dir: str, steps: int = 300) -> str:
+def pretrain_on_walks(config: TRLConfig, sample_walks, out_dir: str, steps: int = 300,
+                      lr: float = 1e-3) -> str:
     """SFT the tiny model on sampled walks first (the reference's PPO randomwalks
     starts from the walk-pretrained CarperAI/randomwalks checkpoint; a random-init
     model emits only invalid paths, so PPO has no reward signal). Exports an
@@ -84,7 +85,7 @@ def pretrain_on_walks(config: TRLConfig, sample_walks, out_dir: str, steps: int 
         checkpoint_interval=10 * steps,
         checkpoint_dir=out_dir + "/sft_ckpts",
     )
-    d["optimizer"]["kwargs"]["lr"] = 1e-3
+    d["optimizer"]["kwargs"]["lr"] = lr
     # pretraining always trains the full random-init model; layer-freezing hparams
     # (e.g. num_layers_unfrozen for the PPO hydra stage) must not leak in here
     d["model"]["num_layers_unfrozen"] = -1
@@ -99,12 +100,15 @@ def main(hparams={}):
     metric_fn, prompts, *_rest, alphabet = generate_random_walks(seed=1002)
     _, _, sample_walks, _, _ = generate_random_walks(seed=1002)
     hparams = dict(hparams)
-    # not a TRLConfig field: SFT warm-start budget (the >=1B xl leg shrinks it)
+    # not TRLConfig fields: SFT warm-start budget and lr (the default 1e-3 fits
+    # the 144-wide tiny model; the >=1B xl leg needs ~1e-4 or the loss spikes)
     pretrain_steps = int(hparams.pop("pretrain_steps", 300))
+    pretrain_lr = float(hparams.pop("pretrain_lr", 1e-3))
     config = TRLConfig.update(default_config(alphabet).to_dict(), hparams)
 
     out_dir = config.train.checkpoint_dir
-    hf_dir = pretrain_on_walks(config, sample_walks, out_dir, steps=pretrain_steps)
+    hf_dir = pretrain_on_walks(config, sample_walks, out_dir, steps=pretrain_steps,
+                               lr=pretrain_lr)
     config.model.model_path = hf_dir
     # architecture now comes from the exported config.json; keep only the
     # compile-layout overrides the HF config cannot record

@@ -141,6 +141,13 @@ def _legs():
             # budget this size affords: a clearly rising curve toward ~0.7+.
             hparams={
                 "pretrain_steps": 120,
+                "pretrain_lr": 1e-4,  # 1e-3 (tiny-model default) spikes at 1.47B
+                "optimizer.kwargs.lr": 1e-4,
+                "optimizer.kwargs.max_grad_norm": 1.0,
+                "scheduler.name": "cosine_warmup",
+                "scheduler.kwargs.warmup_steps": 10,
+                "scheduler.kwargs.total_steps": 400,
+                "scheduler.kwargs.eta_min": 1e-5,
                 "train.total_steps": 25, "train.eval_interval": 3,
                 "train.batch_size": 16,
                 "model.model_overrides.num_layers": 48,
@@ -176,9 +183,16 @@ def _legs():
             # affords; the full config runs on the TPU queue variant.
             hparams_cpu={"mesh.data": 1, "mesh.fsdp": 1,
                          "mesh.compute_dtype": "float32",
+                         # f32 masters on CPU: plain optax.adamw keeps moments
+                         # in the PARAM dtype, and bf16 masters+moments at
+                         # depth 48 destabilize the first updates (loss 3.3->7
+                         # at both lr 1e-3 and 1e-4); the TPU variant keeps
+                         # bf16 params with the 8-bit optimizer's f32 math
+                         "mesh.param_dtype": "float32",
                          "optimizer.name": "adamw",
-                         "pretrain_steps": 80,
-                         "train.total_steps": 20},
+                         "pretrain_steps": 60,
+                         "train.total_steps": 18,
+                         "train.eval_interval": 5},
             env_cpu={"XLA_FLAGS": "--xla_force_host_platform_device_count=1"},
             log_dir=ck("parity_ppo_xl"), target=0.7, timeout_s=14400,
         ),
@@ -235,6 +249,13 @@ def main():
         )
         curve["converged"] = bool(curve.get("best", -1e9) >= spec["target"])
         curve["platform"] = f"{plat.get('platform')} ({plat.get('device')})"
+        cache_dir = os.environ.get("TRLX_COMPILE_CACHE")
+        if cache_dir and os.path.isdir(cache_dir):
+            entries = [os.path.join(cache_dir, e) for e in os.listdir(cache_dir)]
+            curve["compile_cache"] = {
+                "entries": len(entries),
+                "mb": round(sum(os.path.getsize(e) for e in entries if os.path.isfile(e)) / 1e6, 1),
+            }
         if err:
             curve["error"] = err
         result[name] = curve
