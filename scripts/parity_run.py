@@ -196,6 +196,42 @@ def _legs():
             env_cpu={"XLA_FLAGS": "--xla_force_host_platform_device_count=1"},
             log_dir=ck("parity_ppo_xl"), target=0.7, timeout_s=14400,
         ),
+        "ppo_350m": dict(
+            script=os.path.join(REPO, "examples", "randomwalks", "ppo_randomwalks.py"),
+            # gpt2-medium-shaped (~354M) convergence leg: the largest size a
+            # single CPU core turns around inside a round (measured: 1.47B is
+            # ~5 min/step — scripts/xl_microbench.py — so the >=1B convergence
+            # claim is TPU-queue-only). Same memory machinery as ppo_xl:
+            # scan_layers + full remat + host-offloaded KL ref + warmup/clip.
+            hparams={
+                "pretrain_steps": 50,
+                "pretrain_lr": 1e-4,
+                "optimizer.kwargs.lr": 1e-4,
+                "optimizer.kwargs.max_grad_norm": 1.0,
+                "scheduler.name": "cosine_warmup",
+                "scheduler.kwargs.warmup_steps": 8,
+                "scheduler.kwargs.total_steps": 300,
+                "scheduler.kwargs.eta_min": 1e-5,
+                "train.total_steps": 15, "train.eval_interval": 3,
+                "train.batch_size": 16,
+                "model.model_overrides.num_layers": 24,
+                "model.model_overrides.hidden_size": 1024,
+                "model.model_overrides.num_heads": 16,
+                "model.model_overrides.intermediate_size": 4096,
+                "model.model_overrides.scan_layers": True,
+                "model.model_overrides.remat": "nothing_saveable",
+                "model.offload_ref": True,
+                "method.num_rollouts": 16,
+                "method.chunk_size": 16,
+                "method.ppo_epochs": 2,
+            },
+            hparams_cpu={"mesh.data": 1, "mesh.fsdp": 1,
+                         "mesh.compute_dtype": "float32",
+                         "mesh.param_dtype": "float32",
+                         "optimizer.name": "adamw"},
+            env_cpu={"XLA_FLAGS": "--xla_force_host_platform_device_count=1"},
+            log_dir=ck("parity_ppo_350m"), target=0.6, timeout_s=9000,
+        ),
     }
 
 
@@ -247,6 +283,22 @@ def main():
             name, spec["script"], hparams, log_dir,
             timeout_s=spec.get("timeout_s", 5400), env=leg_env,
         )
+        prior = result.get(name)
+        if isinstance(prior, dict):
+            # never clobber non-reproducible hand-recorded evidence: a failed
+            # re-run (e.g. the TPU queue draining into a dead relay) keeps the
+            # prior entry and only annotates the attempt
+            if err and not curve.get("eval_curve") and not curve.get("rollout_curve"):
+                prior["last_attempt_error"] = err
+                prior["last_attempt_at"] = time.time()
+                result["measured_at"] = time.time()
+                with open(out_path, "w") as f:
+                    json.dump(result, f, indent=1)
+                print(json.dumps({name: {"kept_prior": True, "error": err}}))
+                continue
+            for keep in ("cpu_infeasibility_record", "model"):
+                if keep in prior and keep not in curve:
+                    curve[keep] = prior[keep]
         curve["converged"] = bool(curve.get("best", -1e9) >= spec["target"])
         curve["platform"] = f"{plat.get('platform')} ({plat.get('device')})"
         cache_dir = os.environ.get("TRLX_COMPILE_CACHE")
