@@ -75,9 +75,10 @@ def _kv_step_bytes(config, B, P, N, kv_dtype_bytes):
     return elems * kv_dtype_bytes
 
 
-def _time_decode(jax, trunk, trunk_params, B, P, N, reps, seed=0):
+def _time_decode(jax, trunk, trunk_params, B, P, N, reps, seed=0, top_k=0, top_p=1.0):
     """Seconds per full rollout (prefill + N decode steps) at batch B: compile
-    once, then average reps timed runs."""
+    once, then average reps timed runs. ``top_k``/``top_p`` time the fused
+    filtered-sampling path (ops/sampling.py::apply_top_k_top_p)."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -95,6 +96,7 @@ def _time_decode(jax, trunk, trunk_params, B, P, N, reps, seed=0):
         lambda p, i, m, r: generate(
             dstep, p, lambda bb, s: trunk.init_cache(bb, s), i, m, r,
             max_new_tokens=N, eos_token_id=None, pad_token_id=0, do_sample=True,
+            top_k=top_k, top_p=top_p,
         )["sequences"]
     )
     res = decode_fn(trunk_params, ids, mask, jax.random.PRNGKey(1))
@@ -236,6 +238,10 @@ def _gpt2_perf_impl(jax, impl):
         out["gpt2_rollout_new_tok_s_int8kv"] = round(B * N / dt_q, 1)
         kv_q_bytes = _kv_step_bytes(config, B, P, N, None)  # int8 layout
         out["gpt2_rollout_bw_bound_tok_s_int8kv"] = round(bw / (param_bytes + kv_q_bytes) * B, 1)
+        # fused top-k/top-p sampling (HF gpt2 defaults top_k=50): the nucleus
+        # cutoff sorts k values instead of the 50257-wide vocab each step
+        dt_k = _time_decode(jax, trunk, trunk_params, B, P, N, reps, top_k=50, top_p=0.95)
+        out["gpt2_rollout_new_tok_s_topk50_topp95"] = round(B * N / dt_k, 1)
         # bf16 rollout param copy (train.rollout_param_dtype): decode streams
         # every weight per token, so f32 masters pay 2x weight bandwidth
         bf16_params = jax.tree.map(

@@ -214,6 +214,10 @@ def _legs():
                 "scheduler.kwargs.eta_min": 1e-5,
                 "train.total_steps": 15, "train.eval_interval": 3,
                 "train.batch_size": 16,
+                # small fixed KL anchor: randomwalks' default init_kl_coef=0
+                # lets a 354M policy over-optimize and wobble late in the run
+                # (first r4 attempt: rollout 0.713 @ step 12 -> 0.479 @ 15)
+                "method.init_kl_coef": 0.02,
                 "model.model_overrides.num_layers": 24,
                 "model.model_overrides.hidden_size": 1024,
                 "model.model_overrides.num_heads": 16,
