@@ -69,3 +69,29 @@ def test_run_job_success_and_retry_cap(tmp_path, monkeypatch):
     state = tpu_watch.load_state()
     assert state["attempts"]["bad"] == tpu_watch.MAX_ATTEMPTS_PER_JOB
     assert tpu_watch.pending_jobs(state) == []
+
+
+def test_bench_fresh_tpu_cache_promotion(tmp_path, monkeypatch):
+    """bench.py must promote a mid-round TPU capture over the CPU fallback —
+    but only if it is newer than the last committed BENCH artifact (a stale
+    cache from an earlier round was round 3's failure mode)."""
+    import time as _time
+
+    import bench
+
+    cache = tmp_path / "cache.json"
+    monkeypatch.setattr(bench, "TPU_CACHE", str(cache))
+
+    # no cache file at all
+    assert bench._fresh_tpu_cache() is None
+
+    # fresh capture (newer than every BENCH_r0*.json in the repo)
+    cache.write_text(json.dumps(
+        {"platform": "tpu", "value": 123.0, "measured_at": _time.time() + 10}))
+    fresh = bench._fresh_tpu_cache()
+    assert fresh is not None and fresh["value"] == 123.0
+
+    # stale capture (older than the committed BENCH artifacts)
+    cache.write_text(json.dumps(
+        {"platform": "tpu", "value": 99.0, "measured_at": 1.0}))
+    assert bench._fresh_tpu_cache() is None
