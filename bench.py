@@ -512,18 +512,36 @@ def _log_attempt(event: str, **extra) -> None:
         pass
 
 
+def _round_marker():
+    """The set of committed BENCH round artifacts — a content-stable round
+    identifier. A capture is from THIS round iff the same artifact set exists
+    now as at capture time: the driver adds BENCH_r0{N}.json only after the
+    round ends, and (unlike file mtimes, which a clone/checkout or a mid-round
+    driver touch rewrites — ADVICE r4) the name set survives those events."""
+    import glob as _glob
+
+    return sorted(
+        os.path.basename(p)
+        for p in _glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json"))
+    )
+
+
 def _fresh_tpu_cache():
-    """The cached TPU measurement, if it was captured THIS round (newer than the
-    last committed BENCH artifact). A mid-round capture by scripts/tpu_watch.py
-    must survive the relay dying again before the end-of-round bench run."""
+    """The cached TPU measurement, if it was captured THIS round. A mid-round
+    capture by scripts/tpu_watch.py must survive the relay dying again before
+    the end-of-round bench run."""
     try:
         with open(TPU_CACHE) as f:
             cached = json.load(f)
     except (OSError, json.JSONDecodeError):
         return None
+    marker = cached.get("round_marker")
+    if marker is not None:
+        return cached if marker == _round_marker() else None
+    # legacy cache without a marker: fall back to the mtime heuristic
     import glob as _glob
 
-    prior = _glob.glob(os.path.join(REPO_ROOT, "BENCH_r0*.json"))
+    prior = _glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json"))
     floor = max((os.path.getmtime(p) for p in prior), default=0.0)
     if cached.get("measured_at", 0) > floor:
         return cached
@@ -557,7 +575,8 @@ def main():
     if result is not None and result.get("platform") == "tpu":
         try:
             with open(TPU_CACHE, "w") as f:
-                json.dump(dict(result, measured_at=time.time()), f)
+                json.dump(dict(result, measured_at=time.time(),
+                               round_marker=_round_marker()), f)
         except OSError:
             pass
     if result is None:

@@ -271,6 +271,7 @@ def main():
     plat = platform_info(env)
     legs = _legs()
     targets = result.setdefault("target", {})
+    failed = []
 
     for name in names:
         spec = legs[name]
@@ -299,6 +300,7 @@ def main():
                 with open(out_path, "w") as f:
                     json.dump(result, f, indent=1)
                 print(json.dumps({name: {"kept_prior": True, "error": err}}))
+                failed.append(name)
                 continue
             for keep in ("cpu_infeasibility_record", "model"):
                 if keep in prior and keep not in curve:
@@ -314,14 +316,19 @@ def main():
             }
         if err:
             curve["error"] = err
+            failed.append(name)
         result[name] = curve
         result["measured_at"] = time.time()
         with open(out_path, "w") as f:  # persist after EVERY leg
             json.dump(result, f, indent=1)
         print(json.dumps({name: {k: curve.get(k) for k in ("start", "final", "best", "converged", "error")}}))
 
-    print(json.dumps({"out": out_path, "legs_done": names}))
+    print(json.dumps({"out": out_path, "legs_done": names, "failed": failed}))
+    # a failed leg must fail the invocation: callers that gate on rc=0 (the
+    # TPU watcher's job queue) would otherwise mark a dead-relay attempt as
+    # permanently done and never retry it (ADVICE r4)
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

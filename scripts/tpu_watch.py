@@ -59,7 +59,13 @@ def save_state(state):
 def verify_artifact(job, started_at=0.0) -> bool:
     """A job counts as done only if its artifact exists, was (re)written by this
     run, and (when the job says so) records a real-TPU platform — rc=0 on a CPU
-    fallback or a stale artifact is not evidence."""
+    fallback or a stale artifact is not evidence.
+
+    ``verify_contains`` is a whole-file substring check (fine for single-record
+    artifacts like the bench cache). Jobs whose artifact is SHARED across legs
+    (PARITY_r5.json) must use ``verify_json_path``: a dotted path into the JSON
+    plus ``verify_json_contains`` — otherwise one TPU leg's platform string
+    would verify every later CPU-fallback leg in the same file."""
     path = job.get("artifact")
     if not path:
         return True
@@ -68,6 +74,19 @@ def verify_artifact(job, started_at=0.0) -> bool:
         return False
     if os.path.getmtime(path) < started_at:
         return False
+    json_path = job.get("verify_json_path")
+    if json_path:
+        needle = job.get("verify_json_contains")
+        if not needle:  # a path with no needle would vacuously pass — config error
+            return False
+        try:
+            with open(path) as f:
+                node = json.load(f)
+            for key in json_path.split("."):
+                node = node[key]
+        except (OSError, json.JSONDecodeError, KeyError, TypeError):
+            return False
+        return needle in str(node)
     needle = job.get("verify_contains")
     if needle:
         try:
@@ -115,20 +134,37 @@ def pending_jobs(state):
             and state["attempts"].get(j["name"], 0) < MAX_ATTEMPTS_PER_JOB]
 
 
+def reset_attempts_for_revival(state):
+    """A fresh relay window deserves fresh retries: attempts spent draining
+    into a relay that died mid-job must not permanently exhaust a job's
+    MAX_ATTEMPTS_PER_JOB budget (the cap guards against a job that fails on a
+    HEALTHY relay looping forever, not against relay flakiness)."""
+    undone = {n: a for n, a in state["attempts"].items() if n not in state["done"]}
+    if undone:
+        _log_attempt("attempts_reset", jobs=sorted(undone), source="tpu_watch")
+        for name in undone:
+            state["attempts"][name] = 0
+        save_state(state)
+
+
 def main():
     once = "--once" in sys.argv
     state = load_state()
     _log_attempt("watcher_start", pending=[j["name"] for j in pending_jobs(state)],
                  source="tpu_watch")
+    was_alive = False
     while True:
         if os.path.exists(STOP):
             _log_attempt("watcher_stop", reason="stop file", source="tpu_watch")
             return 0
+        alive = _tunnel_alive()
+        if alive and not was_alive:
+            reset_attempts_for_revival(state)
+        was_alive = alive
         pending = pending_jobs(state)
         if not pending:
             _log_attempt("watcher_done", source="tpu_watch")
             return 0
-        alive = _tunnel_alive()
         _log_attempt("probe", alive=alive, pending=len(pending), source="tpu_watch")
         if alive:
             # drain as much as possible while the relay is up; re-probe between

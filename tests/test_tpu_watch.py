@@ -40,6 +40,47 @@ def test_verify_artifact_rejects_stale_and_wrong_content(tmp_path):
     assert tpu_watch.verify_artifact({}, started_at=time.time())
 
 
+def test_verify_artifact_json_path_is_leg_scoped(tmp_path):
+    """Shared-artifact jobs (PARITY_r5.json) verify THEIR leg's platform, not
+    any tpu string anywhere in the file: one earlier TPU leg must not mark a
+    later CPU-fallback leg as done (code-review r5 finding)."""
+    art = tmp_path / "parity.json"
+    art.write_text(json.dumps({
+        "ppo_randomwalks": {"platform": "tpu (TPU v4)", "best": 0.98},
+        "ilql_randomwalks": {"platform": "cpu (cpu)", "best": 0.83},
+    }))
+    tpu_leg = {"artifact": str(art), "verify_json_path": "ppo_randomwalks.platform",
+               "verify_json_contains": "tpu"}
+    cpu_leg = {"artifact": str(art), "verify_json_path": "ilql_randomwalks.platform",
+               "verify_json_contains": "tpu"}
+    missing_leg = {"artifact": str(art), "verify_json_path": "ppo_sentiments.platform",
+                   "verify_json_contains": "tpu"}
+    assert tpu_watch.verify_artifact(tpu_leg, started_at=0.0)
+    assert not tpu_watch.verify_artifact(cpu_leg, started_at=0.0)
+    assert not tpu_watch.verify_artifact(missing_leg, started_at=0.0)
+    # the whole-file needle WOULD have passed the cpu leg — the hole json_path closes
+    assert tpu_watch.verify_artifact(
+        {"artifact": str(art), "verify_contains": "tpu"}, started_at=0.0)
+    # a json_path without a needle is a config error, not a vacuous pass
+    assert not tpu_watch.verify_artifact(
+        {"artifact": str(art), "verify_json_path": "ilql_randomwalks.platform"},
+        started_at=0.0)
+
+
+def test_attempts_reset_on_relay_revival(tmp_path, monkeypatch):
+    """Attempts burned draining into a dying relay must not permanently
+    exhaust a job's retry budget: a dead->alive transition resets the count
+    for jobs not yet done (code-review r5 finding)."""
+    _patch_paths(monkeypatch, tmp_path)
+    state = {"done": {"finished": 1.0},
+             "attempts": {"finished": 1, "flaky": tpu_watch.MAX_ATTEMPTS_PER_JOB}}
+    tpu_watch.save_state(state)
+    tpu_watch.reset_attempts_for_revival(state)
+    assert state["attempts"]["flaky"] == 0        # gets a fresh budget
+    assert state["attempts"]["finished"] == 1     # done jobs left alone
+    assert tpu_watch.load_state()["attempts"]["flaky"] == 0  # persisted
+
+
 def test_run_job_success_and_retry_cap(tmp_path, monkeypatch):
     _patch_paths(monkeypatch, tmp_path)
     art = tmp_path / "out.json"
@@ -73,25 +114,52 @@ def test_run_job_success_and_retry_cap(tmp_path, monkeypatch):
 
 def test_bench_fresh_tpu_cache_promotion(tmp_path, monkeypatch):
     """bench.py must promote a mid-round TPU capture over the CPU fallback —
-    but only if it is newer than the last committed BENCH artifact (a stale
-    cache from an earlier round was round 3's failure mode)."""
+    but only if it was captured THIS round. Freshness is judged by the
+    round_marker (the set of committed BENCH_r0*.json names at capture time),
+    which survives checkouts/clones and mid-round driver touches that rewrite
+    file mtimes (ADVICE r4); legacy marker-less caches fall back to mtimes."""
+    import os as _os
     import time as _time
 
     import bench
 
+    # run against a throwaway repo root: the mtime assertions below must not
+    # touch (and permanently re-stamp) the REAL committed BENCH artifacts
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    for name in ("BENCH_r01.json", "BENCH_r02.json"):
+        (repo / name).write_text("{}")
+    monkeypatch.setattr(bench, "REPO_ROOT", str(repo))
     cache = tmp_path / "cache.json"
     monkeypatch.setattr(bench, "TPU_CACHE", str(cache))
 
     # no cache file at all
     assert bench._fresh_tpu_cache() is None
 
-    # fresh capture (newer than every BENCH_r0*.json in the repo)
+    # this-round capture: marker matches the current artifact set
     cache.write_text(json.dumps(
-        {"platform": "tpu", "value": 123.0, "measured_at": _time.time() + 10}))
+        {"platform": "tpu", "value": 123.0, "measured_at": _time.time(),
+         "round_marker": bench._round_marker()}))
     fresh = bench._fresh_tpu_cache()
     assert fresh is not None and fresh["value"] == 123.0
 
-    # stale capture (older than the committed BENCH artifacts)
+    # marker freshness must NOT depend on artifact mtimes: touching a BENCH
+    # artifact after the capture (the round driver re-writing it mid-round
+    # demoted genuinely fresh captures before) changes nothing
+    _os.utime(repo / "BENCH_r01.json")  # mtime -> now, after measured_at
+    assert bench._fresh_tpu_cache() is not None
+
+    # prior-round capture: a BENCH artifact landed since -> marker mismatch
     cache.write_text(json.dumps(
-        {"platform": "tpu", "value": 99.0, "measured_at": 1.0}))
+        {"platform": "tpu", "value": 99.0, "measured_at": _time.time() + 10,
+         "round_marker": ["BENCH_r01.json"]}))
+    assert bench._fresh_tpu_cache() is None
+
+    # legacy cache without a marker: mtime heuristic still applies
+    cache.write_text(json.dumps(
+        {"platform": "tpu", "value": 77.0, "measured_at": _time.time() + 10}))
+    fresh = bench._fresh_tpu_cache()
+    assert fresh is not None and fresh["value"] == 77.0
+    cache.write_text(json.dumps(
+        {"platform": "tpu", "value": 55.0, "measured_at": 1.0}))
     assert bench._fresh_tpu_cache() is None

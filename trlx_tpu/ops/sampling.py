@@ -50,13 +50,15 @@ def apply_top_k_top_p(logits: jnp.ndarray, k: int, p: float) -> jnp.ndarray:
     selection; the sort shrinks from V to k elements — V/k less sort work per
     decode step, e.g. 50257 -> 50 for gpt2 sampling defaults).
 
-    Equivalent to ``apply_top_p(apply_top_k(logits, k), p)`` whenever no logit
-    ties the k-th largest value (after top-k masking, softmax over the masked
-    vocab then equals softmax over the k kept values, so the cumulative-mass
-    cutoff is identical). With ties at the k-th value both paths keep every
-    tied token, but this cutoff normalizes over k values instead of k+ties, so
-    it can be at most one probability bin stricter — a measure-zero event for
-    real-valued model logits."""
+    Equivalent to ``apply_top_p(apply_top_k(logits, k), p)`` up to float
+    rounding at the cumulative-mass boundary: absent ties at the k-th value
+    the two paths keep the same nucleus *mathematically*, but they normalize
+    softmax over different element counts (k here vs V after masking), so a
+    boundary token whose cumulative mass lands within float eps of ``p`` can
+    flip between the two (observed at |cum - p| ~ 1e-6 with k=256, p=0.999).
+    With ties at the k-th value this cutoff normalizes over k values instead
+    of k+ties, so it can be at most one probability bin stricter — a
+    measure-zero event for real-valued model logits."""
     vals = jax.lax.top_k(logits, k)[0]  # [.., k], sorted descending
     kth = vals[..., -1:]
     kept = jnp.where(logits < kth, NEG_INF, logits)

@@ -250,7 +250,16 @@ class PPOTrainer(MeshRLTrainer):
             self._ref_host = jax.device_put(tree, host_sh)
             jax.block_until_ready(self._ref_host)
             self._ref_host_kind = "pinned_host"
-        except Exception:
+        except Exception as e:
+            # the numpy fallback gathers the whole tree to one host, which is
+            # only correct (and only possible — np.asarray of a non-addressable
+            # sharded jax.Array raises) in a single-process run; on multi-host
+            # a pinned_host failure is a real configuration error, not
+            # something to paper over (ADVICE r4)
+            if jax.process_count() > 1:
+                raise
+            logger.info(f"offload_ref: pinned_host placement unavailable ({type(e).__name__}: {e}); "
+                        "falling back to host numpy copies")
             self._ref_host = jax.tree.map(lambda x: np.asarray(x), tree)
             self._ref_host_kind = "numpy"
         logger.info(f"offload_ref: frozen reference held in {self._ref_host_kind} host memory")
