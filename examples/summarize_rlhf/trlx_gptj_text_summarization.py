@@ -29,6 +29,9 @@ TINY = dict(
     intermediate_size=512, max_position_embeddings=256,
 )
 KEYWORDS = ["storm", "market", "goal", "election", "rocket", "forest", "virus", "bridge"]
+# rows[EVAL_SPLIT:] are reserved for offline evaluation only (no stage trains
+# or optimizes on them — see the split comment in main())
+EVAL_SPLIT = 364
 
 
 def make_dataset(n=400, seed=0):
@@ -98,13 +101,42 @@ def main(hparams={}, base_dir="ckpts/summarize", sft_steps=150, rm_steps=150):
     ppo_config.tokenizer.tokenizer_path = "bytes"
     ppo_config = TRLConfig.update(ppo_config.to_dict(), hparams)
 
-    prompts = sorted({doc for doc, _, _ in rows[300:]})
-    return trlx_tpu.train(
-        reward_fn=reward_fn, prompts=prompts, eval_prompts=prompts[:16], config=ppo_config
+    # live ROUGE eval vs the gold summaries (the reference computes this only
+    # offline in trlx_inference_gptj.py; here it is the eval metric_fn, so every
+    # evaluate() logs metrics/rouge1..rouge_avg toward the published table —
+    # README: avg ROUGE SFT 0.240 / PPO 0.223, reward 2.729 / 3.291)
+    from examples.summarize_rlhf.rouge_eval import make_metric_fn
+
+    gold_by_prompt = {doc: good for doc, good, _ in rows}
+    metric_fn = make_metric_fn(gold_by_prompt, score_fn=lambda s: score_fn(list(s)))
+
+    # splits: SFT/RM train on rows[:300]; PPO optimizes prompts from
+    # rows[300:EVAL_SPLIT]; rows[EVAL_SPLIT:] are touched by NO stage — the
+    # held-out set the rouge_eval harness scores both checkpoints on (scoring
+    # PPO on its own training prompts would inflate its ROUGE column)
+    prompts = sorted({doc for doc, _, _ in rows[300:EVAL_SPLIT]})
+    trainer = trlx_tpu.train(
+        reward_fn=reward_fn, prompts=prompts, eval_prompts=prompts[:16],
+        metric_fn=metric_fn, config=ppo_config,
     )
+    # export the PPO policy next to the SFT one so the rouge_eval harness can
+    # score both checkpoints of the reference's table
+    trainer.save_pretrained(f"{base_dir}/ppo_model")
+    return trainer
 
 
 if __name__ == "__main__":
     import json
 
-    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})
+    argv = sys.argv[1:]
+    kwargs = {}
+    for flag, key, cast in (
+        ("--base-dir", "base_dir", str),
+        ("--sft-steps", "sft_steps", int),
+        ("--rm-steps", "rm_steps", int),
+    ):
+        if flag in argv:
+            i = argv.index(flag)
+            kwargs[key] = cast(argv[i + 1])
+            del argv[i:i + 2]
+    main(json.loads(argv[0]) if argv else {}, **kwargs)

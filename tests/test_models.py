@@ -193,3 +193,37 @@ def test_value_branch_rejects_cache_and_overdepth():
     model = CausalLMWithValueHead(config, num_value_layers=5)  # > num_layers=2
     with _pytest.raises(ValueError):
         model.init(jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32), jnp.ones((1, 4), jnp.int32))
+
+
+def test_depth_scaled_residual_init():
+    """Residual-out projections (o_proj/down_proj) must initialize at
+    initializer_range/sqrt(2L) so the residual stream's variance stays
+    depth-independent (HF GPT-2 _init_weights semantics, which the reference
+    inherits via from_pretrained; VERDICT r4: flat 0.02 at depth 48 produced
+    first-step loss spikes that depth-24 never showed). Other projections keep
+    the flat std, and depth_scaled_init=False restores the old behavior."""
+    import math
+
+    def stds(depth, scaled):
+        config = tiny_config("gpt2").replace(
+            hidden_size=64, num_heads=4, num_layers=depth, depth_scaled_init=scaled
+        )
+        params = TransformerLM(config).init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32), jnp.ones((1, 8), jnp.int32)
+        )["params"]
+        layer = params["layers_0"]
+        return (
+            float(np.std(np.asarray(layer["attn"]["o_proj"]["kernel"]))),
+            float(np.std(np.asarray(layer["mlp"]["down_proj"]["kernel"]))),
+            float(np.std(np.asarray(layer["attn"]["q_proj"]["kernel"]))),
+        )
+
+    for depth in (2, 32):
+        expected = 0.02 / math.sqrt(2 * depth)
+        o_std, down_std, q_std = stds(depth, scaled=True)
+        assert abs(o_std - expected) / expected < 0.25, (depth, o_std, expected)
+        assert abs(down_std - expected) / expected < 0.25, (depth, down_std, expected)
+        assert abs(q_std - 0.02) / 0.02 < 0.25, (depth, q_std)
+
+    o_std, down_std, _ = stds(32, scaled=False)
+    assert abs(o_std - 0.02) / 0.02 < 0.25 and abs(down_std - 0.02) / 0.02 < 0.25

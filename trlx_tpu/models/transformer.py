@@ -82,6 +82,16 @@ class TransformerConfig:
     final_norm: bool = True
 
     initializer_range: float = 0.02
+    # Scale the residual-out projections (o_proj/down_proj) by 1/sqrt(2*L):
+    # each residual stream sums 2L projection outputs, so flat-std init grows
+    # the stream variance linearly with depth — the depth-48 first-step loss
+    # spikes PARITY_r4 recorded (3.3 -> 7-13 under clip+warmup) while depth-24
+    # trained cleanly. HF GPT-2 applies exactly this scaling in _init_weights
+    # ("Scale initializations of select weights... by 1/sqrt(2*n_layer)"), and
+    # the reference inherits it through from_pretrained/from_config
+    # (/root/reference/trlx/models/modeling_base.py:124-161); random-init runs
+    # here need it explicitly. Off reproduces the flat 0.02 behavior.
+    depth_scaled_init: bool = True
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     remat: str = "none"  # "none" | "full" | "nothing_saveable" | "dots_saveable"
@@ -137,6 +147,14 @@ class TransformerConfig:
     @property
     def ffn_dim(self) -> int:
         return self.intermediate_size or 4 * self.hidden_size
+
+    def residual_init_std(self) -> float:
+        """Init std for projections writing into the residual stream
+        (o_proj/down_proj): ``initializer_range / sqrt(2*num_layers)`` under
+        ``depth_scaled_init`` (see the field's comment), flat otherwise."""
+        if self.depth_scaled_init:
+            return self.initializer_range / math.sqrt(2 * self.num_layers)
+        return self.initializer_range
 
     def replace(self, **kw) -> "TransformerConfig":
         return replace(self, **kw)
@@ -385,11 +403,12 @@ class Attention(nn.Module):
         the prefix k/v only); single-token decode steps use XLA over the cache."""
         c = self.config
         B, T, _ = x.shape
-        dense = lambda feats, name, bias: LoraDense(
+        dense = lambda feats, name, bias, std=c.initializer_range: LoraDense(
             feats, use_bias=bias, dtype=c.compute_dtype, param_dtype=c.param_dtype,
-            kernel_init=nn.initializers.normal(c.initializer_range), name=name,
+            kernel_init=nn.initializers.normal(std), name=name,
             r=c.lora_r if name in c.lora_targets else 0, alpha=c.lora_alpha,
         )
+        res_std = c.residual_init_std()
         q = dense(c.num_heads * c.dim_per_head, "q_proj", c.attn_bias)(x)
         k = dense(c.kv_heads * c.dim_per_head, "k_proj", c.attn_bias)(x)
         v = dense(c.kv_heads * c.dim_per_head, "v_proj", c.attn_bias)(x)
@@ -425,14 +444,35 @@ class Attention(nn.Module):
         # index must be a concrete 0 at trace time (true for generate()'s prefill,
         # never true inside the decode while_loop or for chunked appends, which
         # fall back to attending over the full cache via XLA).
+        # With a cache present, a non-None kv_valid IS the prefill-from-zero
+        # marker: TransformerLM only passes it when the cache index was a
+        # concrete 0 at trace time (checked there, outside the remat wrapper —
+        # in here cache["index"] may be a remat tracer even at prefill).
         use_flash = (
             c.attention_impl == "flash"
             and kv_valid is not None
             and T > 1
             and c.pos_embedding != "alibi"  # kernel takes no additive bias
             and c.peft_type != "prefix"  # prefix keys break the kernel's causal index math
-            and (cache is None or _concrete_zero(cache["index"]))
         )
+        # Mosaic kernels cannot be auto-partitioned by XLA SPMD: on a
+        # multi-device mesh the flash call must be placed explicitly (batch and
+        # head axes are embarrassingly parallel) via shard_map, and a shape
+        # that cannot divide those axes falls back to the einsum paths below.
+        flash_mesh = None
+        if use_flash:
+            flash_mesh = ambient_mesh()
+            if flash_mesh is not None:
+                n_batch = int(np.prod([flash_mesh.shape.get(a, 1) for a in BATCH_AXES]))
+                n_model = flash_mesh.shape.get(MODEL_AXIS, 1)
+                if flash_mesh.size == 1:
+                    # single device: plain call. (Any larger mesh must go via
+                    # the shard_map wrapper even when batch/model axes are
+                    # trivial — e.g. a pipe-only mesh still has an auto axis
+                    # the Mosaic kernel cannot sit under.)
+                    flash_mesh = None
+                elif B % n_batch or c.num_heads % n_model or c.kv_heads % n_model:
+                    use_flash = False  # kernel cannot place; XLA attention below
         # kh/vh [B, Hkv, S, D]: the layout attention consumes (and the cache layout)
         if cache is not None and not use_flash:
             # attend over the cache (decode step / XLA prefill)
@@ -494,18 +534,37 @@ class Attention(nn.Module):
                     kv_valid=kv_valid, batch_axes=BATCH_AXES,
                 ).transpose(0, 2, 1, 3).astype(c.compute_dtype)
                 out = out.reshape(B, T, c.num_heads * c.dim_per_head)
-                out = dense(c.hidden_size, "o_proj", c.attn_bias)(out)
+                out = dense(c.hidden_size, "o_proj", c.attn_bias, res_std)(out)
                 return out, new_cache
             # fall through to XLA when the mesh/shape can't ring
 
         if use_flash:
             # the kernel maps query head h -> kv head h // rep natively: grouped
             # K/V are never materialized at full head count
-            from trlx_tpu.ops.attention import flash_attention
-            out = flash_attention(
-                q.transpose(0, 2, 1, 3), kh, vh,
-                kv_valid, True, scale, 128, 128, jax.default_backend() == "cpu",
-            ).transpose(0, 2, 1, 3).astype(c.compute_dtype)
+            from trlx_tpu.ops.attention import flash_attention, flash_attention_sharded
+
+            # interpret (XLA-emulated) mode iff the COMPILE TARGET is CPU. The
+            # ambient mesh's devices name the target; default_backend alone is
+            # wrong under deviceless TPU AOT compilation (scripts/scale_proof.py
+            # runs with a CPU host backend but lowers for a TPU topology, where
+            # interpret mode would re-materialize the score matrices the kernel
+            # exists to avoid).
+            target = (
+                flash_mesh.devices.flat[0].platform
+                if flash_mesh is not None
+                else jax.default_backend()
+            )
+            if flash_mesh is not None:
+                out = flash_attention_sharded(
+                    q.transpose(0, 2, 1, 3), kh, vh, kv_valid, True, scale, 128, 128,
+                    target == "cpu", flash_mesh, BATCH_AXES, MODEL_AXIS,
+                )
+            else:
+                out = flash_attention(
+                    q.transpose(0, 2, 1, 3), kh, vh,
+                    kv_valid, True, scale, 128, 128, target == "cpu",
+                )
+            out = out.transpose(0, 2, 1, 3).astype(c.compute_dtype)
         elif c.kv_heads != c.num_heads:
             # grouped-query einsum: batch scores over kv heads with the group as
             # a free axis — the old jnp.repeat path copied the whole K/V cache to
@@ -529,7 +588,7 @@ class Attention(nn.Module):
             probs = jax.nn.softmax(scores, axis=-1).astype(c.compute_dtype)
             out = jnp.einsum("bhts,bhsd->bthd", probs, vh)
         out = out.reshape(B, T, c.num_heads * c.dim_per_head)
-        out = dense(c.hidden_size, "o_proj", c.attn_bias)(out)
+        out = dense(c.hidden_size, "o_proj", c.attn_bias, res_std)(out)
         return out, new_cache
 
 
@@ -539,9 +598,9 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         c = self.config
-        dense = lambda feats, name: LoraDense(
+        dense = lambda feats, name, std=c.initializer_range: LoraDense(
             feats, use_bias=c.mlp_bias, dtype=c.compute_dtype, param_dtype=c.param_dtype,
-            kernel_init=nn.initializers.normal(c.initializer_range), name=name,
+            kernel_init=nn.initializers.normal(std), name=name,
             r=c.lora_r if name in c.lora_targets else 0, alpha=c.lora_alpha,
         )
         act = _act(c.activation)
@@ -549,7 +608,7 @@ class MLP(nn.Module):
             h = act(dense(c.ffn_dim, "gate_proj")(x)) * dense(c.ffn_dim, "up_proj")(x)
         else:
             h = act(dense(c.ffn_dim, "up_proj")(x))
-        return dense(c.hidden_size, "down_proj")(h)
+        return dense(c.hidden_size, "down_proj", c.residual_init_std())(h)
 
 
 class Block(nn.Module):
@@ -737,9 +796,14 @@ class TransformerLM(nn.Module):
                     self.prompt_embeddings.astype(x.dtype)[None], (B, nv, c.hidden_size)
                 )
                 x = jnp.concatenate([pe, x], axis=1)
-            if T_eff > 1 and ext_mask is not None:
+            if T_eff > 1 and ext_mask is not None and _concrete_zero(idx):
                 # generation prefill: the cache is written from slot 0, so the
-                # flash path may attend over the prefix k/v alone
+                # flash path may attend over the prefix k/v alone. The
+                # concrete-zero check must happen HERE, outside the remat
+                # wrapper around the blocks: nn.remat turns every cache leaf —
+                # including a Python-int index — into a tracer, so a check
+                # inside Attention can never see the concrete 0 and would
+                # silently disable flash prefill whenever remat is on.
                 kv_valid = ext_mask[:, :T_eff]
             else:
                 kv_valid = None
@@ -815,6 +879,15 @@ class TransformerLM(nn.Module):
             hidden = hidden[:, nv_rows:]
         new_cache = None
         if cache is not None:
+            if c.stacked:
+                # re-pin the written cache's layout: the decode while_loop's
+                # carry sharding follows the BODY output, and unpinned it
+                # reverts to GSPMD's choice (replicated over pipe — see
+                # _constrain_cache_leaf)
+                stacked_kv = {
+                    k: self._constrain_cache_leaf(v, stacked=True)
+                    for k, v in stacked_kv.items()
+                }
             new_cache = {**stacked_kv, "index": cache["index"] + T + nv_rows}
         if branch_layer is not None and not isinstance(branch_layer, tuple):
             branch_out = captures.get(branch_layer)
@@ -874,6 +947,28 @@ class TransformerLM(nn.Module):
         logits, _ = self._final(x)
         return logits
 
+    def _constrain_cache_leaf(self, x: jnp.ndarray, stacked: bool) -> jnp.ndarray:
+        """Pin the KV-cache layout over the mesh. Stacked decode ([L, B, H, ...]
+        leaves) runs a sequential layer scan on EVERY device, so the layer dim
+        must stay local — decode under pipeline layouts is pure data
+        parallelism over `pipe`: batch shards over (pipe, data, fsdp), kv heads
+        over `model`. Left to GSPMD propagation the cache came back REPLICATED
+        over pipe (17.5G/device at 7B decode batch 128), and sharding the LAYER
+        dim over pipe instead makes the scan all-gather the whole cache (both
+        measured by the v5e compiler, scripts/scale_proof.py). No-op outside a
+        mesh context; non-divisible dims are dropped."""
+        mesh = ambient_mesh()
+        if mesh is None:
+            return x
+        from trlx_tpu.parallel.sharding import _clip_spec
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        batch_entry = ((PIPE_AXIS,) + BATCH_AXES) if stacked else BATCH_AXES
+        entries = ([None] if stacked else []) + [batch_entry, MODEL_AXIS]
+        entries += [None] * (x.ndim - len(entries))
+        spec = _clip_spec(PartitionSpec(*entries), x.shape, mesh)
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
     def init_cache(self, batch_size: int, max_length: int, dtype=None) -> KVCache:
         c = self.config
         dtype = dtype or c.compute_dtype
@@ -884,7 +979,9 @@ class TransformerLM(nn.Module):
         if c.stacked:
             # nn.scan layout needs one [L, ...] array per k/v
             out = {
-                key: jnp.zeros((c.num_layers,) + shp, dt)
+                key: self._constrain_cache_leaf(
+                    jnp.zeros((c.num_layers,) + shp, dt), stacked=True
+                )
                 for key, (shp, dt) in per_layer.items()
             }
             out["index"] = jnp.array(0, jnp.int32)

@@ -524,3 +524,59 @@ def _bwd(causal, scale, block_q, block_k, interpret, res, g):
 
 
 flash_attention.defvjp(_fwd, _bwd)
+
+
+def flash_attention_sharded(
+    q, k, v, kv_valid, causal: bool, scale: Optional[float],
+    block_q: int, block_k: int, interpret: bool,
+    mesh, batch_axes, head_axis,
+):
+    """SPMD placement for the flash kernel: Mosaic kernels cannot be
+    auto-partitioned by XLA's SPMD pass (it raises at compile time on any
+    multi-device mesh), so shard the embarrassingly-parallel grid axes
+    explicitly — batch over ``batch_axes``, heads over ``head_axis`` — and run
+    the kernel per shard inside a ``shard_map``. No cross-shard terms exist:
+    each (batch, head) pair's softmax is independent, and the grouped-KV head
+    map stays consistent because H_local/Hkv_local equals the global ratio
+    when both divide the axis. Differentiable: autodiff enters the shard_map
+    and applies the kernel's custom VJP per shard."""
+    from jax.sharding import PartitionSpec as P
+
+    def local(q, k, v, kv_valid):
+        return flash_attention(
+            q, k, v, kv_valid, causal, scale, block_q, block_k, interpret
+        )
+
+    # The map must be manual over EVERY mesh axis the SPMD partitioner would
+    # otherwise see — a Mosaic op under any remaining auto axis (e.g. `pipe`
+    # during stacked-decode prefill) still raises cannot-be-auto-partitioned.
+    # When nested inside an enclosing shard_map (the GPipe stage body is manual
+    # over `pipe`), the tracing context's AbstractMesh must be named instead of
+    # the concrete mesh, and its already-manual axes must be excluded.
+    # jax.shard_map (not the experimental alias) carries the axis_names param.
+    from jax.sharding import get_abstract_mesh
+
+    amesh = get_abstract_mesh()
+    already_manual = set()
+    if amesh is not None and amesh.axis_names:
+        already_manual = {
+            n for n, t in zip(amesh.axis_names, amesh.axis_types) if "Manual" in str(t)
+        }
+        mesh = amesh
+    axes = set(mesh.axis_names) - already_manual
+    # Spare manual axes beyond batch/heads (e.g. `pipe` during stacked-decode
+    # prefill) stay UNNAMED in the specs: each of their shards computes its
+    # replica. Redundant compute, but folding them into the batch entry
+    # instead miscompiled — XLA's partitioner emitted an invalid dynamic-slice
+    # over the pipe-sharded stacked layer params ("slice dim size 4096 greater
+    # than dynamic slice dimension: 2048", v5e compiler, scripts/scale_proof.py)
+    # — and prefill under a pipe mesh is a once-per-generation cost.
+    batch_entry = tuple(batch_axes) if isinstance(batch_axes, tuple) else (batch_axes,)
+    batch_entry = tuple(a for a in batch_entry if a in axes)
+    head_entry = head_axis if head_axis in axes else None
+    spec = P(batch_entry or None, head_entry, None, None)
+    vspec = P(batch_entry or None, None)
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec, vspec), out_specs=spec,
+        check_vma=False, axis_names=axes,
+    )(q, k, v, kv_valid)

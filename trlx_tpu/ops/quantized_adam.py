@@ -123,8 +123,12 @@ def adamw_8bit(
 
         params_like = params if params is not None else grads
         flat = jax.tree.map(upd, grads, state["moments"], params_like)
-        updates = jax.tree.map(lambda x: x[0], flat, is_leaf=lambda x: isinstance(x, tuple))
-        moments = jax.tree.map(lambda x: x[1], flat, is_leaf=lambda x: isinstance(x, tuple))
+        # pairs only: optax.masked (multi_transform freeze groups) injects
+        # MaskedNode — an EMPTY NamedTuple, i.e. an empty tuple — for frozen
+        # leaves; unpacking it as a (update, state) pair raises IndexError
+        is_pair = lambda x: isinstance(x, tuple) and len(x) == 2
+        updates = jax.tree.map(lambda x: x[0], flat, is_leaf=is_pair)
+        moments = jax.tree.map(lambda x: x[1], flat, is_leaf=is_pair)
         return updates, {"count": count, "moments": moments}
 
     return optax.GradientTransformation(init, update)

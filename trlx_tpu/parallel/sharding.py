@@ -147,6 +147,53 @@ def shard_params(params: Any, mesh: Mesh, rules: Optional[Sequence[Rule]] = None
     return jax.tree.map(jax.device_put, params, shardings)
 
 
+def _path_str(path) -> str:
+    """jax key-path -> the "a/b/c" strings the partition rules match (handles
+    dict keys, namedtuple fields, and sequence indices)."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def make_state_shardings(state_tree: Any, mesh: Mesh, rules: Optional[Sequence[Rule]] = None) -> Any:
+    """NamedShardings for an OPTIMIZER STATE pytree (optax namedtuples wrapping
+    param-shaped moment trees). Moment leaves keep their param's layout because
+    their key paths end with the same parameter path the regex rules match
+    (``.../mu/transformer/layers_0/attn/q_proj/kernel``); scalars and
+    quantized-moment blocks hit the replicated catch-all.
+
+    This must be applied EXPLICITLY (``jit(tx.init, out_shardings=...)``):
+    leaving the state placement to GSPMD propagation replicates the moments —
+    ``zeros_like`` outputs carry no input-derived sharding, and a replicated
+    Adam state for a full-finetune 7B is 54G on EVERY device (measured by the
+    v5e compiler in scripts/scale_proof.py's earlier runs)."""
+    from jax.tree_util import tree_flatten_with_path
+
+    rules = list(rules) if rules is not None else default_lm_rules()
+    # 8-bit Adam stores blockwise-quantized moments ([n_blocks, 256] int8 +
+    # per-block scales) whose paths end in m_q/v_q/..., never matching the
+    # kernel rules — shard their block dim over fsdp rather than replicating
+    # (dropped by _clip_spec when n_blocks doesn't divide)
+    rules = [
+        (r".*/(m_q|v_q|m_scale|v_scale)$", PartitionSpec(FSDP_AXIS)),
+    ] + rules
+    leaves, treedef = tree_flatten_with_path(state_tree)
+    shardings = []
+    for path, leaf in leaves:
+        shape = tuple(leaf.shape if hasattr(leaf, "shape") else np.shape(leaf))
+        spec = _clip_spec(spec_for_path(_path_str(path), rules), shape, mesh)
+        shardings.append(NamedSharding(mesh, spec))
+    return treedef.unflatten(shardings)
+
+
 _warned_no_mesh_api = False
 
 
