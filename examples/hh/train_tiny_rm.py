@@ -108,18 +108,30 @@ def pairwise_accuracy(score_fn, pairs, batch: int = 64) -> float:
     return correct / len(pairs)
 
 
-def train_ranking_rm(out_dir: str, steps: int, seed: int = 0) -> float:
-    """Train + save the JAX ranking RM; returns held-out pairwise accuracy."""
+def _resolve_rm_tokenizer(tokenizer_path: str):
+    from trlx_tpu.data.configs import TokenizerConfig
+    from trlx_tpu.pipeline.tokenization import load_tokenizer
+
+    return load_tokenizer(TokenizerConfig(tokenizer_path=tokenizer_path))
+
+
+def train_ranking_rm(out_dir: str, steps: int, seed: int = 0,
+                     tokenizer_path: str = "bytes") -> float:
+    """Train + save the JAX ranking RM; returns held-out pairwise accuracy.
+
+    ``tokenizer_path`` must match the policy's tokenizer family (a bpe://
+    tokenizer for the BPE hh sizes): the RM has to read exactly the strings
+    the policy emits (VERDICT r4 item 5)."""
     from flax import serialization
 
     from examples.summarize_rlhf.reward_model import train_reward_model
     from trlx_tpu.models.transformer import TransformerConfig
-    from trlx_tpu.pipeline.tokenization import ByteTokenizer
 
     import jax.numpy as jnp
 
-    tokenizer = ByteTokenizer()
-    config = TransformerConfig(**RM_ARCH, compute_dtype=jnp.float32, param_dtype=jnp.float32)
+    tokenizer = _resolve_rm_tokenizer(tokenizer_path)
+    arch = dict(RM_ARCH, vocab_size=max(RM_ARCH["vocab_size"], tokenizer.vocab_size))
+    config = TransformerConfig(**arch, compute_dtype=jnp.float32, param_dtype=jnp.float32)
     train_pairs = [(a, b) for a, b, _ in graded_pairs(4000, seed=seed)]
     _, params, score_fn = train_reward_model(
         train_pairs, tokenizer, config,
@@ -145,8 +157,8 @@ def train_ranking_rm(out_dir: str, steps: int, seed: int = 0) -> float:
         f.write(serialization.to_bytes(params))
     meta = {
         "kind": "ranking_rm",
-        "arch": RM_ARCH,
-        "tokenizer": "bytes",
+        "arch": arch,
+        "tokenizer": tokenizer_path,
         "seq_len": RM_SEQ_LEN,
         "train_steps": steps,
         "heldout_pairwise_acc": round(acc, 4),
@@ -171,7 +183,6 @@ def load_ranking_rm(model_dir: str):
     from examples.summarize_rlhf.reward_model import RewardModel
     from trlx_tpu.models.transformer import TransformerConfig
     from trlx_tpu.ops.generation import left_pad_batch
-    from trlx_tpu.pipeline.tokenization import ByteTokenizer
 
     with open(os.path.join(model_dir, RM_META)) as f:
         meta = json.load(f)
@@ -182,7 +193,7 @@ def load_ranking_rm(model_dir: str):
     )["params"]
     with open(os.path.join(model_dir, RM_PARAMS), "rb") as f:
         params = serialization.from_bytes(template, f.read())
-    tokenizer = ByteTokenizer()
+    tokenizer = _resolve_rm_tokenizer(meta.get("tokenizer", "bytes"))
     seq_len = int(meta["seq_len"])
     apply = jax.jit(lambda ids, mask: model.apply({"params": params}, ids, mask))
 
@@ -292,13 +303,18 @@ def main():
     parser.add_argument("--out", default="ckpts/tiny_rm_rank")
     parser.add_argument("--steps", type=int, default=2000)
     parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--tokenizer", default="bytes",
+                        help='RM tokenizer (e.g. "bpe://ckpts/hh_bpe_1024.json"); '
+                             "must match the policy's tokenizer family")
     parser.add_argument("--classifier", action="store_true",
                         help="legacy torch DistilBERT classifier mode")
     args = parser.parse_args()
     if args.classifier:
         train_classifier_rm(args.out, args.steps, args.batch_size)
     else:
-        train_ranking_rm(args.out, args.steps)
+        train_ranking_rm(args.out, args.steps, seed=args.seed,
+                         tokenizer_path=args.tokenizer)
 
 
 if __name__ == "__main__":

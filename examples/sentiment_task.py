@@ -129,7 +129,8 @@ TINY_MODEL_OVERRIDES = dict(
 
 def _sft_offline_base(base_dir: str, model_path: str, arch_type: str,
                       model_overrides: Dict, samples, steps: int, seed: int,
-                      seq_length: int = 64) -> str:
+                      seq_length: int = 64, tokenizer_path: str = "bytes",
+                      batch_size: int = 32, fingerprint_extra: str = "") -> str:
     """Shared warm-start recipe: SFT the tiny model on synthetic-task samples and
     export an HF dir once (cached by directory + recipe fingerprint — a stale
     cache from different overrides/steps/seed/corpus silently poisons PPO)."""
@@ -137,10 +138,15 @@ def _sft_offline_base(base_dir: str, model_path: str, arch_type: str,
 
     hf_dir = os.path.join(base_dir, "sft_model")
     fp_path = os.path.join(hf_dir, "recipe_fingerprint.txt")
-    fingerprint = hashlib.sha256(
-        repr((model_path, arch_type, sorted(model_overrides.items()), steps, seed,
-              seq_length, samples)).encode()
-    ).hexdigest()[:16]
+    fp_parts = (model_path, arch_type, sorted(model_overrides.items()), steps, seed,
+                seq_length, samples)
+    if tokenizer_path != "bytes":  # legacy fingerprints stay valid for byte bases
+        fp_parts = fp_parts + (tokenizer_path,)
+    if batch_size != 32:  # same legacy-compat rule: non-defaults must re-key the cache
+        fp_parts = fp_parts + (batch_size,)
+    if fingerprint_extra:  # e.g. the BPE merge-file content hash
+        fp_parts = fp_parts + (fingerprint_extra,)
+    fingerprint = hashlib.sha256(repr(fp_parts).encode()).hexdigest()[:16]
     if os.path.exists(os.path.join(hf_dir, "config.json")):
         try:
             with open(fp_path) as f:
@@ -158,7 +164,7 @@ def _sft_offline_base(base_dir: str, model_path: str, arch_type: str,
     config = default_sft_config()
     config = config.evolve(
         train={
-            "seq_length": seq_length, "batch_size": 32, "total_steps": steps,
+            "seq_length": seq_length, "batch_size": batch_size, "total_steps": steps,
             "eval_interval": steps, "checkpoint_interval": 10 * steps,
             "checkpoint_dir": os.path.join(base_dir, "sft_ckpts"), "tracker": None,
             "seed": seed,
@@ -167,7 +173,7 @@ def _sft_offline_base(base_dir: str, model_path: str, arch_type: str,
     config.model.model_path = model_path
     config.model.model_arch_type = arch_type
     config.model.model_overrides = dict(model_overrides)
-    config.tokenizer.tokenizer_path = "bytes"
+    config.tokenizer.tokenizer_path = tokenizer_path
     config.optimizer.kwargs["lr"] = 1e-3
     trainer = trlx_tpu.train(samples=samples, eval_prompts=PROMPT_STUBS[:2], config=config)
     trainer.save_pretrained(hf_dir)

@@ -73,7 +73,9 @@ def main(hparams={}, base_dir="ckpts/summarize", sft_steps=150, rm_steps=150):
     # ---- stage 2: pairwise reward model on (chosen, rejected)
     tokenizer = load_tokenizer(sft_config.tokenizer)
     rm_config = PRESETS["gpt2"].replace(**TINY, compute_dtype=np.float32)
-    pairs = [(doc + good, doc + bad) for doc, good, bad in rows]
+    # RM trains only on the SFT split: rows[EVAL_SPLIT:] must stay untouched by
+    # every stage or the held-out reward column measures memorization
+    pairs = [(doc + good, doc + bad) for doc, good, bad in rows[:300]]
     _, _, score_fn = train_reward_model(pairs, tokenizer, rm_config, steps=rm_steps)
 
     # delta-vs-SFT normalization (parity: reference normalizes PPO rewards by the

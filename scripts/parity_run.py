@@ -47,17 +47,25 @@ def run_leg(name, script, hparams, log_dir, timeout_s=5400, env=None):
     return curve, err
 
 
-def parse_jsonl_curve(log_dir):
-    """Extract rollout/eval reward curves from the newest jsonl tracker file."""
+def iter_tracker_rows(log_dir):
+    """Parsed rows of the NEWEST jsonl tracker under ``log_dir`` (the single
+    place that knows the tracker layout — curve parsing and the hh KL
+    accounting both consume it)."""
     files = sorted(glob.glob(os.path.join(log_dir, "logs", "*.jsonl")), key=os.path.getmtime)
-    out = {"rollout_curve": [], "eval_curve": []}
     if not files:
-        return out
+        return
     for line in open(files[-1]):
         try:
             row = json.loads(line)
         except json.JSONDecodeError:
             continue
+        yield row
+
+
+def parse_jsonl_curve(log_dir):
+    """Extract rollout/eval reward curves from the newest jsonl tracker file."""
+    out = {"rollout_curve": [], "eval_curve": []}
+    for row in iter_tracker_rows(log_dir):
         step = row.get("step")
         if step is None:
             continue

@@ -194,3 +194,41 @@ def test_grounded_dsl_interpreter():
         out = _json.loads(s.split("Output:")[1].split("Function:")[0].strip())
         code = s.split("Function:")[1].strip()
         assert (interp(code, xs) == out) == (r > 0)
+
+
+def test_bpe_tokenizer_roundtrip_and_compression(tmp_path):
+    """From-scratch byte-level BPE (trlx_tpu/pipeline/bpe.py): merges learned
+    on a corpus must (a) roundtrip exactly on arbitrary text, (b) compress
+    corpus words into multi-byte tokens, (c) persist through save/load and the
+    bpe:// tokenizer scheme (VERDICT r4 item 5: move the hh chain off
+    char-level tokenization)."""
+    from trlx_tpu.data.configs import TokenizerConfig
+    from trlx_tpu.pipeline.bpe import BPETokenizer, train_bpe, train_and_save
+    from trlx_tpu.pipeline.tokenization import load_tokenizer
+
+    corpus = ["the helpful assistant gives helpful answers"] * 50 + [
+        "the unhelpful assistant gives harmful answers"] * 30
+    merges = train_bpe(corpus, vocab_size=300)
+    assert merges, "no merges learned"
+    tok = BPETokenizer(merges)
+
+    # exact roundtrip, including text with characters unseen at training time
+    for text in corpus[:1] + ["Human: zebra quartz?! 42", "  spaces  galore "]:
+        assert tok.decode(tok.encode(text)) == text
+
+    # corpus words compress below their byte length
+    ids = tok.encode("the helpful assistant")
+    assert len(ids) < len("the helpful assistant".encode())
+
+    # novel words still encode (fall back to bytes), ids stay in-vocab
+    ids = tok.encode("xyzzy")
+    assert ids and all(0 <= i < tok.vocab_size for i in ids)
+
+    # save -> load -> load_tokenizer(bpe://) give identical encodings
+    path = str(tmp_path / "bpe.json")
+    saved = train_and_save(corpus, 300, path)
+    loaded = load_tokenizer(TokenizerConfig(tokenizer_path=f"bpe://{path}"))
+    text = "the helpful assistant gives harmful answers"
+    assert saved.encode(text) == loaded.encode(text) == BPETokenizer(merges).encode(text)
+    assert loaded.vocab_size == saved.vocab_size
+    assert loaded.decode(loaded.encode(text)) == text

@@ -6,6 +6,8 @@ benchmark task `examples/randomwalks` likewise builds its own toy vocab —
 ``tokenizer_path`` resolution:
 - ``"char://<alphabet>"``  → :class:`CharTokenizer` over the given alphabet
 - ``"bytes"``              → :class:`ByteTokenizer` (vocab 256 + specials)
+- ``"bpe://<file>"``       → :class:`trlx_tpu.pipeline.bpe.BPETokenizer` (saved
+  from-scratch byte-level BPE trained on a task corpus)
 - anything else            → ``transformers.AutoTokenizer`` (local files / cache)
 """
 
@@ -135,6 +137,12 @@ def load_tokenizer(config: TokenizerConfig):
         return tok
     if path == "bytes":
         return ByteTokenizer(config.padding_side, config.truncation_side)
+    if path.startswith("bpe://"):
+        from trlx_tpu.pipeline.bpe import BPETokenizer
+
+        return BPETokenizer.load(
+            path[len("bpe://"):], config.padding_side, config.truncation_side
+        )
     import transformers
 
     tok = transformers.AutoTokenizer.from_pretrained(path, **config.tokenizer_extra_kwargs)
