@@ -75,10 +75,12 @@ def _kv_step_bytes(config, B, P, N, kv_dtype_bytes):
     return elems * kv_dtype_bytes
 
 
-def _time_decode(jax, trunk, trunk_params, B, P, N, reps, seed=0, top_k=0, top_p=1.0):
+def _time_decode(jax, trunk, trunk_params, B, P, N, reps, seed=0, top_k=0, top_p=1.0,
+                 top_k_impl="approx"):
     """Seconds per full rollout (prefill + N decode steps) at batch B: compile
-    once, then average reps timed runs. ``top_k``/``top_p`` time the fused
-    filtered-sampling path (ops/sampling.py::apply_top_k_top_p)."""
+    once, then average reps timed runs. ``top_k``/``top_p`` time the candidate-
+    space filtered-sampling path (ops/sampling.py::sample_token), with
+    ``top_k_impl`` choosing approx_max_k vs exact lax.top_k selection."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -96,7 +98,7 @@ def _time_decode(jax, trunk, trunk_params, B, P, N, reps, seed=0, top_k=0, top_p
         lambda p, i, m, r: generate(
             dstep, p, lambda bb, s: trunk.init_cache(bb, s), i, m, r,
             max_new_tokens=N, eos_token_id=None, pad_token_id=0, do_sample=True,
-            top_k=top_k, top_p=top_p,
+            top_k=top_k, top_p=top_p, top_k_impl=top_k_impl,
         )["sequences"]
     )
     res = decode_fn(trunk_params, ids, mask, jax.random.PRNGKey(1))
@@ -242,6 +244,9 @@ def _gpt2_perf_impl(jax, impl):
         # cutoff sorts k values instead of the 50257-wide vocab each step
         dt_k = _time_decode(jax, trunk, trunk_params, B, P, N, reps, top_k=50, top_p=0.95)
         out["gpt2_rollout_new_tok_s_topk50_topp95"] = round(B * N / dt_k, 1)
+        dt_ke = _time_decode(jax, trunk, trunk_params, B, P, N, reps, top_k=50, top_p=0.95,
+                             top_k_impl="exact")
+        out["gpt2_rollout_new_tok_s_topk50_topp95_exact"] = round(B * N / dt_ke, 1)
         # bf16 rollout param copy (train.rollout_param_dtype): decode streams
         # every weight per token, so f32 masters pay 2x weight bandwidth
         bf16_params = jax.tree.map(
@@ -351,8 +356,11 @@ def _attn_mem_probe(jax):
         if temp is not None:
             out[f"attn_bwd_temp_mb_{name}_s2048"] = round(temp / 1e6, 1)
     if len(out) == 2:
+        # On TPU the Pallas kernel's scratch lives in VMEM, so its HBM temp can
+        # be exactly 0; floor at 1 MB so the ratio stays meaningful (">=537x"
+        # rather than a divide-by-~0 artifact).
         out["attn_bwd_mem_ratio_xla_over_flash"] = round(
-            out["attn_bwd_temp_mb_xla_s2048"] / max(out["attn_bwd_temp_mb_flash_s2048"], 1e-9), 1
+            out["attn_bwd_temp_mb_xla_s2048"] / max(out["attn_bwd_temp_mb_flash_s2048"], 1.0), 1
         )
     return out
 

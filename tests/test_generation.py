@@ -246,3 +246,49 @@ def test_int8_kv_cache_decode_matches_fp_cache(layout):
     got = jnp.stack(logits_steps, axis=1)
     err = float(jnp.max(jnp.abs(got - ref_logits)))
     assert err < 0.5, err
+
+
+def test_candidate_space_sampling_distribution_matches_masked_full_vocab():
+    """sample_token's k-candidate-space pipeline (top-k select -> nucleus mask
+    over the k sorted values -> categorical over k -> gather id) must induce
+    the SAME per-token distribution as masking the full-V logits and sampling
+    over V: softmax is invariant to NEG_INF entries, so with exact selection
+    the two are analytically equal. Compared via probabilities (scattered
+    k-space softmax vs full-V softmax of the fused mask), not samples — the
+    RNG draw shapes differ by construction."""
+    from trlx_tpu.ops.sampling import apply_top_k_top_p
+
+    rng = np.random.default_rng(3)
+    logits = jnp.asarray(rng.normal(size=(4, 97)).astype(np.float32) * 2.5)
+    for k, p in ((1, 1.0), (5, 1.0), (13, 0.9), (50, 0.5)):
+        vals, idx = jax.lax.top_k(logits, k)
+        if p < 1.0:
+            probs_k = jax.nn.softmax(vals, axis=-1)
+            cum = jnp.cumsum(probs_k, axis=-1)
+            keep = jnp.concatenate(
+                [jnp.ones_like(cum[..., :1], bool), cum[..., :-1] < p], axis=-1
+            )
+            vals = jnp.where(keep, vals, -1e9)
+        cand_probs = jax.nn.softmax(vals, axis=-1)  # [B, k]
+        scattered = np.zeros(logits.shape, np.float64)
+        np.put_along_axis(scattered, np.asarray(idx), np.asarray(cand_probs, np.float64), -1)
+        ref_probs = np.asarray(jax.nn.softmax(apply_top_k_top_p(logits, k, p), axis=-1))
+        np.testing.assert_allclose(scattered, ref_probs, atol=2e-6)
+
+
+def test_sample_token_approx_impl_samples_from_topk_region():
+    """The default approx selection must (a) run under jit on every backend,
+    (b) with k=1 still return the argmax, and (c) only emit tokens whose logit
+    is >= the true (2k)-th value — approx_max_k's recall shaping can swap a
+    near-tied tail neighbor in, but never a far-tail token."""
+    rng = np.random.default_rng(11)
+    logits = jnp.asarray(rng.normal(size=(16, 211)).astype(np.float32) * 3)
+    tok1 = jax.jit(lambda r, l: sample_token(r, l, top_k=1))(jax.random.PRNGKey(0), logits)
+    np.testing.assert_array_equal(np.asarray(tok1), np.asarray(jnp.argmax(logits, -1)))
+    k = 8
+    tok = jax.jit(lambda r, l: sample_token(r, l, top_k=k, top_p=0.9))(
+        jax.random.PRNGKey(1), logits
+    )
+    floor = np.asarray(jax.lax.top_k(logits, 2 * k)[0][:, -1])
+    sampled_logit = np.asarray(logits)[np.arange(logits.shape[0]), np.asarray(tok)]
+    assert (sampled_logit >= floor - 1e-6).all()

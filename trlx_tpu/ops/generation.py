@@ -60,6 +60,7 @@ def generate(
     top_k: int = 0,
     top_p: float = 1.0,
     do_sample: bool = True,
+    top_k_impl: str = "approx",
     min_new_tokens: int = 0,
     logits_processor: Optional[Callable[[Any, jnp.ndarray, jnp.ndarray], jnp.ndarray]] = None,
 ) -> Dict[str, jnp.ndarray]:
@@ -100,7 +101,7 @@ def generate(
                 -1e9,
                 logits,
             )
-        tok = sample_token(sub, logits, temperature, top_k, top_p, do_sample)
+        tok = sample_token(sub, logits, temperature, top_k, top_p, do_sample, top_k_impl)
         tok = jnp.where(finished, pad_token_id, tok)
         return rng, tok
 
@@ -175,6 +176,7 @@ def generate_seq2seq(
     top_k: int = 0,
     top_p: float = 1.0,
     do_sample: bool = True,
+    top_k_impl: str = "approx",
     min_new_tokens: int = 0,
     logits_processor=None,
 ) -> Dict[str, jnp.ndarray]:
@@ -206,7 +208,7 @@ def generate_seq2seq(
                 -1e9,
                 logits,
             )
-        tok = sample_token(sub, logits, temperature, top_k, top_p, do_sample)
+        tok = sample_token(sub, logits, temperature, top_k, top_p, do_sample, top_k_impl)
         return rng, jnp.where(finished, pad_token_id, tok)
 
     def cond(state):

@@ -80,13 +80,45 @@ def sample_token(
     top_k: int = 0,
     top_p: float = 1.0,
     do_sample: bool = True,
+    top_k_impl: str = "approx",
 ) -> jnp.ndarray:
-    """Sample (or argmax) next tokens from [B, V] logits -> [B] int32."""
+    """Sample (or argmax) next tokens from [B, V] logits -> [B] int32.
+
+    When ``0 < top_k < V`` the whole top-k/top-p/categorical pipeline runs in
+    the k-candidate space: select (vals, indices), nucleus-mask the k sorted
+    values, draw categorical over k, gather the token id. With exact selection
+    this is distribution-identical to masking the full-V logits and sampling
+    (softmax is invariant to the NEG_INF entries) but removes every full-vocab
+    pass after the selection itself — on chip the old full-V path cost 4.4x
+    decode throughput at B=256/k=50 (bench `gpt2_rollout_new_tok_s_topk50_topp95`
+    11.5k vs 51.0k tok/s plain).
+
+    ``top_k_impl``: "approx" (default) selects candidates with
+    ``jax.lax.approx_max_k`` — the TPU-native binned selection (per-candidate
+    recall 0.95, then an exact top-k over the candidate bins); a true-top-k
+    tail member is occasionally replaced by a near-tied neighbor, the same
+    kind of truncation noise top-k sampling itself introduces (rollout
+    logprobs are computed from the full softmax either way, exactly as the
+    reference's HF top-k sampling does). "exact" uses ``jax.lax.top_k``.
+    """
     if not do_sample:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = apply_temperature(logits.astype(jnp.float32), temperature)
     if 0 < top_k < logits.shape[-1]:
-        logits = apply_top_k_top_p(logits, top_k, top_p)
-    else:
-        logits = apply_top_p(logits, top_p)
+        if top_k_impl == "approx":
+            vals, idx = jax.lax.approx_max_k(
+                logits, top_k, recall_target=0.95, aggregate_to_topk=True
+            )
+        else:
+            vals, idx = jax.lax.top_k(logits, top_k)
+        if top_p < 1.0:
+            probs = jax.nn.softmax(vals, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep = jnp.concatenate(
+                [jnp.ones_like(cum[..., :1], bool), cum[..., :-1] < top_p], axis=-1
+            )
+            vals = jnp.where(keep, vals, NEG_INF)
+        choice = jax.random.categorical(rng, vals, axis=-1)
+        return jnp.take_along_axis(idx, choice[..., None], axis=-1)[..., 0].astype(jnp.int32)
+    logits = apply_top_p(logits, top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
