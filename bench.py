@@ -373,6 +373,13 @@ def measure():
     from examples.randomwalks.ppo_randomwalks import default_config
     from trlx_tpu.utils.loading import get_pipeline, get_trainer
 
+    # persistent compile cache (same env contract as mesh_trainer): on the
+    # tunneled TPU a cached program skips the flaky remote-compile helper
+    cache_dir = os.environ.get("TRLX_COMPILE_CACHE")
+    if cache_dir:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+
     platform = jax.default_backend()
 
     metric_fn, prompts, *_rest, alphabet = generate_random_walks(seed=1002)
@@ -482,10 +489,21 @@ TPU_CACHE = os.path.join(REPO_ROOT, ".bench_tpu_cache.json")
 
 
 def _tunnel_alive() -> bool:
-    """Whether the axon loopback relay accepts connections. The relay process
-    can die mid-session (observed in round 2); the axon client then retries
-    connection-refused forever inside make_c_api_client, so a dead relay means
-    the TPU child would burn its whole deadline for nothing."""
+    """Whether the axon tunnel reaches a LIVE remote terminal. Two failure
+    modes, both observed: (round 2) the local relay process dies — ports
+    refuse, the axon client retries connection-refused forever inside
+    make_c_api_client; (round 5, 2026-07-31 04:08) the REMOTE terminal dies
+    while the local relay keeps listening — ports accept but nothing answers,
+    so a port-open probe is a false positive and every job hangs to its
+    timeout in series. The probe therefore requires an actual HTTP response
+    from the remote-compile endpoint (8103 answers GET with some status —
+    even its 500s prove the remote is alive) within a short deadline.
+
+    Tradeoff, accepted deliberately: a crashed compile HELPER with a live TPU
+    runtime also reads as dead. With no persistent compile cache that state
+    cannot run jobs anyway (every fresh process must compile); with the
+    TRLX_COMPILE_CACHE the watcher now sets, cached programs could — if that
+    state is ever observed, split the probe (runtime ports vs 8103) then."""
     if not os.environ.get("PALLAS_AXON_POOL_IPS"):
         return True  # not tunneled; let jax decide
     import socket
@@ -495,12 +513,24 @@ def _tunnel_alive() -> bool:
         s.settimeout(2)
         try:
             s.connect(("127.0.0.1", port))
-            return True
+            break
         except OSError:
             continue
         finally:
             s.close()
-    return False
+    else:
+        return False
+    # ports accept -> now demand proof of a live remote end
+    s = socket.socket()
+    s.settimeout(8)
+    try:
+        s.connect(("127.0.0.1", 8103))
+        s.sendall(b"GET / HTTP/1.1\r\nHost: axon\r\nConnection: close\r\n\r\n")
+        return bool(s.recv(1))
+    except OSError:
+        return False
+    finally:
+        s.close()
 
 
 RETRY_LOG = os.path.join(REPO_ROOT, "artifacts", "tpu_retry_log.jsonl")

@@ -112,18 +112,19 @@ def ensure_hh_base(base_dir: str = "ckpts/hh_base_r4", steps: int = 400,
     fingerprint_extra = ""
     overrides = dict(spec["overrides"])
     if spec["bpe"]:
-        import hashlib
+        import json as _json
+
+        # key the SFT cache on the MERGE CONTENT, not just the path string: a
+        # retrained tokenizer file means different token ids for the same text.
+        # One hash rule shared with the RM cache (train_tiny_rm) so the SFT and
+        # RM staleness keys can never desynchronize.
+        from examples.hh.train_tiny_rm import resolve_bpe_file, tokenizer_content_sha
 
         tokenizer_path = ensure_hh_bpe(spec["bpe"], seed=seed)
         base_dir = f"{base_dir}_{size}"
-        bpe_file = tokenizer_path[len("bpe://"):]
-        # key the SFT cache on the MERGE CONTENT, not just the path string: a
-        # retrained tokenizer file means different token ids for the same text
-        with open(bpe_file, "rb") as f:
-            fingerprint_extra = hashlib.sha256(f.read()).hexdigest()[:16]
-        from trlx_tpu.pipeline.bpe import BPETokenizer
-
-        overrides["vocab_size"] = BPETokenizer.load(bpe_file).vocab_size
+        fingerprint_extra = tokenizer_content_sha(tokenizer_path) or ""
+        with open(resolve_bpe_file(tokenizer_path)) as f:
+            overrides["vocab_size"] = _json.load(f)["vocab_size"]
     return _sft_offline_base(
         base_dir, "gpt2", "causal", overrides,
         hh_base_corpus(seed=seed), steps, seed, seq_length=spec["seq_length"],

@@ -115,6 +115,34 @@ def _resolve_rm_tokenizer(tokenizer_path: str):
     return load_tokenizer(TokenizerConfig(tokenizer_path=tokenizer_path))
 
 
+def resolve_bpe_file(tokenizer_path: str) -> str:
+    """Filesystem path of a bpe:// tokenizer. Relative paths are repo-relative
+    by convention (the training subprocesses run with cwd=REPO); resolving
+    against the repo root keeps every consumer — content hashing, vocab-size
+    reads — agreeing regardless of the caller's cwd."""
+    path = tokenizer_path[len("bpe://"):]
+    if not os.path.isabs(path):
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        path = os.path.join(repo, path)
+    return path
+
+
+def tokenizer_content_sha(tokenizer_path: str):
+    """Content hash of a file-backed tokenizer (bpe://...), or None for
+    built-ins. Cache keys must include this: the same bpe:// PATH can hold a
+    retrained merge table, and an RM keyed only on the path string would pair
+    stale token ids with a new policy vocabulary."""
+    if not tokenizer_path.startswith("bpe://"):
+        return None
+    import hashlib
+
+    try:
+        with open(resolve_bpe_file(tokenizer_path), "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:
+        return None
+
+
 def train_ranking_rm(out_dir: str, steps: int, seed: int = 0,
                      tokenizer_path: str = "bytes") -> float:
     """Train + save the JAX ranking RM; returns held-out pairwise accuracy.
@@ -159,6 +187,8 @@ def train_ranking_rm(out_dir: str, steps: int, seed: int = 0,
         "kind": "ranking_rm",
         "arch": arch,
         "tokenizer": tokenizer_path,
+        "tokenizer_content_sha": tokenizer_content_sha(tokenizer_path),
+        "seed": seed,
         "seq_len": RM_SEQ_LEN,
         "train_steps": steps,
         "heldout_pairwise_acc": round(acc, 4),

@@ -31,6 +31,7 @@ TINY = dict(
 KEYWORDS = ["storm", "market", "goal", "election", "rocket", "forest", "virus", "bridge"]
 # rows[EVAL_SPLIT:] are reserved for offline evaluation only (no stage trains
 # or optimizes on them — see the split comment in main())
+TRAIN_SPLIT = 300  # SFT + RM train on rows[:TRAIN_SPLIT]; PPO prompts come from rows[TRAIN_SPLIT:EVAL_SPLIT]
 EVAL_SPLIT = 364
 
 
@@ -63,7 +64,7 @@ def main(hparams={}, base_dir="ckpts/summarize", sft_steps=150, rm_steps=150):
     d["optimizer"]["kwargs"]["lr"] = 1e-3
     sft_config = TRLConfig.from_dict(d)
     sft_trainer = trlx_tpu.train(
-        samples=[[doc, good] for doc, good, _ in rows[:300]],
+        samples=[[doc, good] for doc, good, _ in rows[:TRAIN_SPLIT]],
         eval_prompts=[rows[0][0]],
         config=sft_config,
     )
@@ -75,7 +76,7 @@ def main(hparams={}, base_dir="ckpts/summarize", sft_steps=150, rm_steps=150):
     rm_config = PRESETS["gpt2"].replace(**TINY, compute_dtype=np.float32)
     # RM trains only on the SFT split: rows[EVAL_SPLIT:] must stay untouched by
     # every stage or the held-out reward column measures memorization
-    pairs = [(doc + good, doc + bad) for doc, good, bad in rows[:300]]
+    pairs = [(doc + good, doc + bad) for doc, good, bad in rows[:TRAIN_SPLIT]]
     _, _, score_fn = train_reward_model(pairs, tokenizer, rm_config, steps=rm_steps)
 
     # delta-vs-SFT normalization (parity: reference normalizes PPO rewards by the
@@ -112,11 +113,11 @@ def main(hparams={}, base_dir="ckpts/summarize", sft_steps=150, rm_steps=150):
     gold_by_prompt = {doc: good for doc, good, _ in rows}
     metric_fn = make_metric_fn(gold_by_prompt, score_fn=lambda s: score_fn(list(s)))
 
-    # splits: SFT/RM train on rows[:300]; PPO optimizes prompts from
-    # rows[300:EVAL_SPLIT]; rows[EVAL_SPLIT:] are touched by NO stage — the
+    # splits: SFT/RM train on rows[:TRAIN_SPLIT]; PPO optimizes prompts from
+    # rows[TRAIN_SPLIT:EVAL_SPLIT]; rows[EVAL_SPLIT:] are touched by NO stage — the
     # held-out set the rouge_eval harness scores both checkpoints on (scoring
     # PPO on its own training prompts would inflate its ROUGE column)
-    prompts = sorted({doc for doc, _, _ in rows[300:EVAL_SPLIT]})
+    prompts = sorted({doc for doc, _, _ in rows[TRAIN_SPLIT:EVAL_SPLIT]})
     trainer = trlx_tpu.train(
         reward_fn=reward_fn, prompts=prompts, eval_prompts=prompts[:16],
         metric_fn=metric_fn, config=ppo_config,

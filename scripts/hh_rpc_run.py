@@ -4,7 +4,10 @@ Round-5 shape of the hh evidence leg (VERDICT r4 item 5): a BPE-tokenized
 policy (from-scratch byte-level BPE trained on the hh corpus —
 trlx_tpu/pipeline/bpe.py; ``--size tiny`` keeps the round-4 byte-level
 recipe, ``--size 125m`` is the gpt2-124M-shaped TPU-queue variant), a pairwise
-ranking RM with held-out accuracy strictly inside (0.7, 0.95), PPO with
+ranking RM whose held-out accuracy is recorded (design target ~(0.7, 0.95);
+the BPE-tokenized RM separates the graded pairs a bit more cleanly and can
+land just above — the disjoint-seed guard RM is what makes the evidence
+robust to an easy served RM), PPO with
 sustained delta-vs-chosen growth, AND overoptimization guards that
 distinguish learning from reward hacking:
 
@@ -52,15 +55,26 @@ def _free_port() -> int:
 
 
 def ensure_rm(rm_dir: str, tokenizer_path: str, seed: int = 0) -> dict:
+    from examples.hh.train_tiny_rm import tokenizer_content_sha
+
     meta_path = os.path.join(rm_dir, "rm_meta.json")
     if os.path.exists(meta_path):
-        # a cached RM keyed to a DIFFERENT tokenizer reads different token ids
-        # for the same text — retrain rather than serve garbage scores
+        # a cached RM keyed to a DIFFERENT tokenizer (by path OR by merge-table
+        # content — the same bpe:// path can hold a retrained table) reads
+        # different token ids for the same text, and one trained with a
+        # different SEED voids the disjoint-data guarantee the held-out guard
+        # RM exists for — retrain rather than serve garbage/cloned scores
         with open(meta_path) as f:
-            if json.load(f).get("tokenizer", "bytes") != tokenizer_path:
-                import shutil
+            meta = json.load(f)
+        stale = (
+            meta.get("tokenizer", "bytes") != tokenizer_path
+            or meta.get("seed") != seed
+            or meta.get("tokenizer_content_sha") != tokenizer_content_sha(tokenizer_path)
+        )
+        if stale:
+            import shutil
 
-                shutil.rmtree(rm_dir, ignore_errors=True)
+            shutil.rmtree(rm_dir, ignore_errors=True)
     if not os.path.exists(meta_path):
         proc = subprocess.run(
             [sys.executable, "examples/hh/train_tiny_rm.py", "--out", rm_dir,
@@ -143,11 +157,17 @@ def kl_per_reward(log_dir):
             rewards.append(float(row["rollout_scores/mean"]))
     if not kls or len(rewards) < 2:
         return {}
-    gain = max(rewards) - rewards[0]
+    # gain = late-window mean minus early-window mean (same convention as the
+    # curve's late_minus_early): a peak-based gain would make a spike-then-
+    # collapse hacked run look like cheap optimization — the exact failure
+    # mode this price tag exists to expose
+    w = max(1, len(rewards) // 10)
+    gain = sum(rewards[-w:]) / w - sum(rewards[:w]) / w
     mean_kl = sum(kls) / len(kls)
     return {
         "mean_seq_kl_to_base": round(mean_kl, 4),
         "reward_gain": round(gain, 4),
+        "reward_gain_peak": round(max(rewards) - rewards[0], 4),
         "kl_per_unit_reward": round(mean_kl / gain, 4) if gain > 1e-6 else None,
     }
 

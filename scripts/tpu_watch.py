@@ -106,6 +106,11 @@ def run_job(job, state) -> bool:
     save_state(state)
     _log_attempt("job_start", job=name, attempt=attempts + 1, source="tpu_watch")
     env = dict(os.environ)
+    # persistent XLA compile cache for every queue job: compiled programs
+    # survive the flaky remote-compile helper (the round-5 remote death struck
+    # mid-compile; cached programs would have kept the queue draining) and
+    # make retries start fast
+    env.setdefault("TRLX_COMPILE_CACHE", os.path.join(REPO, ".jax_compile_cache"))
     env.update(job.get("env", {}))
     t0 = time.time()
     try:

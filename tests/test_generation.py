@@ -276,19 +276,30 @@ def test_candidate_space_sampling_distribution_matches_masked_full_vocab():
         np.testing.assert_allclose(scattered, ref_probs, atol=2e-6)
 
 
-def test_sample_token_approx_impl_samples_from_topk_region():
-    """The default approx selection must (a) run under jit on every backend,
-    (b) with k=1 still return the argmax, and (c) only emit tokens whose logit
-    is >= the true (2k)-th value — approx_max_k's recall shaping can swap a
-    near-tied tail neighbor in, but never a far-tail token."""
+def test_sample_token_candidate_space_impls():
+    """Exact selection carries hard guarantees: k=1 is argmax, and every
+    sampled token's logit is >= the true k-th value. The approx default only
+    promises an *expected* recall (0.95) — no per-element floor exists on TPU's
+    binned selection — so for it the test pins just the contract that holds on
+    every backend: jits, returns in-range int32 ids, deterministic per key."""
     rng = np.random.default_rng(11)
     logits = jnp.asarray(rng.normal(size=(16, 211)).astype(np.float32) * 3)
-    tok1 = jax.jit(lambda r, l: sample_token(r, l, top_k=1))(jax.random.PRNGKey(0), logits)
-    np.testing.assert_array_equal(np.asarray(tok1), np.asarray(jnp.argmax(logits, -1)))
     k = 8
-    tok = jax.jit(lambda r, l: sample_token(r, l, top_k=k, top_p=0.9))(
+
+    tok1 = jax.jit(lambda r, l: sample_token(r, l, top_k=1, top_k_impl="exact"))(
+        jax.random.PRNGKey(0), logits
+    )
+    np.testing.assert_array_equal(np.asarray(tok1), np.asarray(jnp.argmax(logits, -1)))
+    tok = jax.jit(lambda r, l: sample_token(r, l, top_k=k, top_p=0.9, top_k_impl="exact"))(
         jax.random.PRNGKey(1), logits
     )
-    floor = np.asarray(jax.lax.top_k(logits, 2 * k)[0][:, -1])
+    floor = np.asarray(jax.lax.top_k(logits, k)[0][:, -1])
     sampled_logit = np.asarray(logits)[np.arange(logits.shape[0]), np.asarray(tok)]
     assert (sampled_logit >= floor - 1e-6).all()
+
+    fn = jax.jit(lambda r, l: sample_token(r, l, top_k=k, top_p=0.9))  # approx default
+    ta = fn(jax.random.PRNGKey(2), logits)
+    tb = fn(jax.random.PRNGKey(2), logits)
+    assert ta.dtype == jnp.int32 and ta.shape == (16,)
+    np.testing.assert_array_equal(np.asarray(ta), np.asarray(tb))
+    assert (np.asarray(ta) >= 0).all() and (np.asarray(ta) < 211).all()

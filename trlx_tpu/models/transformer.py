@@ -474,9 +474,27 @@ class Attention(nn.Module):
                 elif B % n_batch or c.num_heads % n_model or c.kv_heads % n_model:
                     use_flash = False  # kernel cannot place; XLA attention below
         # kh/vh [B, Hkv, S, D]: the layout attention consumes (and the cache layout)
+        k_row_scale = v_row_scale = None
         if cache is not None and not use_flash:
             # attend over the cache (decode step / XLA prefill)
-            kh, vh = read_kv_cache(new_cache, c.compute_dtype)
+            if "k_scale" in new_cache and c.peft_type != "prefix":
+                # int8 cache: bare dtype convert only — the per-row scales fold
+                # into the scores (k) and the softmax weights (v) below, which
+                # is algebraically identical to dequantizing the operands but
+                # leaves the big K/V streams a pure int8->bf16 cast XLA fuses
+                # into the dot (the dequant multiply on the operand blocked
+                # that fusion: int8 decode measured only 1.16x plain bf16 at
+                # B=256 despite moving half the bytes). int8 values are exact
+                # in bf16, and the scale multiply happens in f32 on the small
+                # score/prob tensors — strictly less rounding than the old
+                # per-element dequant-to-bf16. (Prefix tuning prepends
+                # scale-less rows, so it keeps the dequant-on-read path.)
+                kh = new_cache["k"].astype(c.compute_dtype)
+                vh = new_cache["v"].astype(c.compute_dtype)
+                k_row_scale = new_cache["k_scale"]  # [B, Hkv, S, 1] f32
+                v_row_scale = new_cache["v_scale"]
+            else:
+                kh, vh = read_kv_cache(new_cache, c.compute_dtype)
         else:
             kh = k.transpose(0, 2, 1, 3)
             vh = v.transpose(0, 2, 1, 3)
@@ -573,19 +591,29 @@ class Attention(nn.Module):
             rep = c.num_heads // c.kv_heads
             qg = q.reshape(B, T, c.kv_heads, rep, c.dim_per_head)
             scores = jnp.einsum("btkrd,bksd->bkrts", qg, kh).astype(jnp.float32) * scale
+            if k_row_scale is not None:
+                scores = scores * k_row_scale[..., 0][:, :, None, None, :]
             bias = (
                 mask_bias[:, :, None]
                 if mask_bias.shape[1] == 1
                 else mask_bias.reshape(B, c.kv_heads, rep, *mask_bias.shape[2:])
             )
-            probs = jax.nn.softmax(scores + bias, axis=-1).astype(c.compute_dtype)
+            probs = jax.nn.softmax(scores + bias, axis=-1)
+            if v_row_scale is not None:
+                probs = probs * v_row_scale[..., 0][:, :, None, None, :]
+            probs = probs.astype(c.compute_dtype)
             # btkrd order flattens to head h = k*rep + r, matching the q reshape
             out = jnp.einsum("bkrts,bksd->btkrd", probs, vh)
         else:
             # [B,H,T,S]
             scores = jnp.einsum("bthd,bhsd->bhts", q, kh).astype(jnp.float32) * scale
+            if k_row_scale is not None:
+                scores = scores * k_row_scale[..., 0][:, :, None, :]
             scores = scores + mask_bias
-            probs = jax.nn.softmax(scores, axis=-1).astype(c.compute_dtype)
+            probs = jax.nn.softmax(scores, axis=-1)
+            if v_row_scale is not None:
+                probs = probs * v_row_scale[..., 0][:, :, None, :]
+            probs = probs.astype(c.compute_dtype)
             out = jnp.einsum("bhts,bhsd->bthd", probs, vh)
         out = out.reshape(B, T, c.num_heads * c.dim_per_head)
         out = dense(c.hidden_size, "o_proj", c.attn_bias, res_std)(out)

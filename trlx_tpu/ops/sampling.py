@@ -44,6 +44,18 @@ def apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
     return jnp.where(logits < cutoff, NEG_INF, logits)
 
 
+def _nucleus_keep(sorted_vals: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Boolean keep-mask over descending-sorted logits: the smallest prefix
+    whose cumulative softmax mass reaches ``p`` (top-1 always kept). The ONE
+    definition of the nucleus boundary — sample_token and apply_top_k_top_p
+    must share it or their distributions silently diverge."""
+    probs = jax.nn.softmax(sorted_vals, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    return jnp.concatenate(
+        [jnp.ones_like(cum[..., :1], bool), cum[..., :-1] < p], axis=-1
+    )
+
+
 def apply_top_k_top_p(logits: jnp.ndarray, k: int, p: float) -> jnp.ndarray:
     """Fused top-k -> top-p: the nucleus cutoff is computed on the k already-
     sorted top-k values instead of a full-vocab sort (``lax.top_k`` is O(V)
@@ -64,11 +76,7 @@ def apply_top_k_top_p(logits: jnp.ndarray, k: int, p: float) -> jnp.ndarray:
     kept = jnp.where(logits < kth, NEG_INF, logits)
     if p >= 1.0:
         return kept
-    probs = jax.nn.softmax(vals, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep_sorted = jnp.concatenate(
-        [jnp.ones_like(cum[..., :1], bool), cum[..., :-1] < p], axis=-1
-    )
+    keep_sorted = _nucleus_keep(vals, p)
     cutoff = jnp.min(jnp.where(keep_sorted, vals, jnp.inf), axis=-1, keepdims=True)
     return jnp.where(kept < cutoff, NEG_INF, kept)
 
@@ -112,12 +120,7 @@ def sample_token(
         else:
             vals, idx = jax.lax.top_k(logits, top_k)
         if top_p < 1.0:
-            probs = jax.nn.softmax(vals, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            keep = jnp.concatenate(
-                [jnp.ones_like(cum[..., :1], bool), cum[..., :-1] < top_p], axis=-1
-            )
-            vals = jnp.where(keep, vals, NEG_INF)
+            vals = jnp.where(_nucleus_keep(vals, top_p), vals, NEG_INF)
         choice = jax.random.categorical(rng, vals, axis=-1)
         return jnp.take_along_axis(idx, choice[..., None], axis=-1)[..., 0].astype(jnp.int32)
     logits = apply_top_p(logits, top_p)
