@@ -25,4 +25,11 @@ if [[ "${1:-}" == "--slow" ]]; then
 else
     python -m pytest tests/ -q -m "not slow"
 fi
+
+echo "== async rollout tests (CPU)"
+# the async engine suite must pass on CPU regardless of the platform the main
+# suite ran on; bounded so a queue/thread deadlock fails fast instead of hanging CI
+JAX_PLATFORMS=cpu timeout -k 10 300 \
+    python -m pytest tests/test_async_rollout.py -q -m "not slow" -p no:cacheprovider
+echo "CI OK"
 echo "CI OK"

@@ -1,0 +1,523 @@
+"""Async rollout engine tests (trlx_tpu/rollout; docs/rollout.md).
+
+CPU-only and fast: the queue/publisher/staleness/engine units run with fake
+produce functions and numpy "parameters"; the loss-identity test checks the
+ISSUE's acceptance criterion that staleness correction is bitwise-invisible on
+on-policy data. The full tiny-model async training run is marked ``slow``.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from trlx_tpu.data.ppo_types import PPORLElement
+from trlx_tpu.rollout import (
+    AsyncRolloutEngine,
+    ExperienceQueue,
+    ParameterPublisher,
+    QueueClosed,
+    StalenessAccountant,
+    staleness_importance_weights,
+)
+
+pytestmark = pytest.mark.async_rollout
+
+
+def make_element(i: int, version: int = 0) -> PPORLElement:
+    return PPORLElement(
+        query_tensor=np.array([i, i + 1], np.int32),
+        response_tensor=np.array([i + 2], np.int32),
+        logprobs=np.array([-0.5], np.float32),
+        values=np.array([0.1], np.float32),
+        rewards=np.array([1.0], np.float32),
+        policy_version=version,
+    )
+
+
+# ------------------------------------------------------------------ queue
+
+
+def test_queue_fifo_and_counters():
+    q = ExperienceQueue(capacity=8)
+    q.put(["a", "b", "c"])
+    assert q.get(2) == ["a", "b"]
+    assert q.get(5, timeout=0.05) == ["c"]  # partial: up to n, never blocks on fullness
+    s = q.stats()
+    assert s["total_put"] == 3 and s["total_got"] == 3 and s["depth"] == 0
+    assert s["peak_depth"] == 3
+
+
+def test_queue_capacity_bound_blocks_put():
+    q = ExperienceQueue(capacity=4)
+    assert q.put([1, 2, 3, 4])
+    # a put that would exceed capacity times out instead of overfilling
+    assert q.put([5], timeout=0.05) is False
+    assert q.stats()["peak_depth"] <= q.capacity
+    # a batch bigger than capacity can never fit: hard error, not a deadlock
+    with pytest.raises(ValueError):
+        q.put(list(range(5)))
+    # draining unblocks a waiting producer
+    done = threading.Event()
+
+    def producer():
+        q.put([5])
+        done.set()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    assert not done.wait(0.05)
+    q.get(4)
+    assert done.wait(2.0)
+    t.join(2.0)
+    assert q.stats()["peak_depth"] <= q.capacity
+
+
+def test_queue_watermark_hysteresis():
+    q = ExperienceQueue(capacity=8, high_watermark=4, low_watermark=2)
+    q.put([1, 2, 3])
+    assert not q.gated
+    q.put([4])  # depth hits high watermark -> gate
+    assert q.gated
+    assert q.put([9], timeout=0.05) is False  # gated even though capacity remains
+    q.get(1)  # depth 3 > low: still gated
+    assert q.gated
+    q.get(1)  # depth 2 == low: released
+    assert not q.gated
+    assert q.put([9], timeout=0.5)
+
+
+def test_queue_watermark_validation():
+    with pytest.raises(ValueError):
+        ExperienceQueue(capacity=0)
+    with pytest.raises(ValueError):
+        ExperienceQueue(capacity=4, high_watermark=2, low_watermark=3)
+    with pytest.raises(ValueError):
+        ExperienceQueue(capacity=4, high_watermark=8)
+
+
+def test_queue_close_drains_then_empties():
+    q = ExperienceQueue(capacity=8)
+    q.put([1, 2, 3])
+    q.close()
+    with pytest.raises(QueueClosed):
+        q.put([4])
+    assert q.get(2) == [1, 2]  # leftover experience is still consumable
+    assert q.get(2) == [3]
+    assert q.get(2) == []  # then empty lists, never a hang
+    q.close()  # idempotent
+
+
+def test_queue_close_wakes_blocked_waiters():
+    q = ExperienceQueue(capacity=1)
+    q.put([1])
+    results = {}
+
+    def blocked_put():
+        try:
+            q.put([2])
+        except QueueClosed:
+            results["put"] = "closed"
+
+    def blocked_get():
+        results["got"] = q2.get(1)
+
+    q2 = ExperienceQueue(capacity=1)
+    t1 = threading.Thread(target=blocked_put, daemon=True)
+    t2 = threading.Thread(target=blocked_get, daemon=True)
+    t1.start()
+    t2.start()
+    time.sleep(0.05)
+    q.close()
+    q2.close()
+    t1.join(2.0)
+    t2.join(2.0)
+    assert results == {"put": "closed", "got": []}
+
+
+# -------------------------------------------------------------- publisher
+
+
+def test_publisher_versions_monotonic_from_zero():
+    pub = ParameterPublisher()
+    with pytest.raises(RuntimeError):
+        pub.latest()
+    assert pub.version == -1
+    params = {"w": np.ones(3, np.float32)}
+    assert pub.publish(params) == 0
+    assert pub.publish(params) == 1
+    assert pub.publish(params) == 2
+    v, snap = pub.latest()
+    assert v == 2 and np.array_equal(snap["w"], np.ones(3))
+
+
+def test_publisher_snapshot_isolated_from_source():
+    pub = ParameterPublisher()
+    params = {"w": np.zeros(3, np.float32)}
+    pub.publish(params)
+    params["w"] += 7.0  # learner keeps mutating its live params
+    _, snap = pub.latest()
+    assert np.array_equal(snap["w"], np.zeros(3))
+
+
+def test_publisher_custom_copy_fn():
+    calls = []
+
+    def copy_fn(tree):
+        calls.append(1)
+        return dict(tree)
+
+    pub = ParameterPublisher(copy_fn=copy_fn)
+    pub.publish({"w": 1})
+    assert calls == [1]
+
+
+# -------------------------------------------------------------- staleness
+
+
+def test_staleness_accountant_caps_and_counts():
+    acc = StalenessAccountant(max_staleness=1)
+    elements = [make_element(i, version=v) for i, v in enumerate([5, 4, 3, 0])]
+    fresh, dropped = acc.admit(elements, learner_version=5)  # staleness 0,1,2,5
+    assert len(fresh) == 2 and dropped == 2
+    assert [int(e.policy_version) for e in fresh] == [5, 4]
+    s = acc.stats()
+    assert s["admitted"] == 2 and s["dropped_stale"] == 2
+    assert s["staleness_mean"] == pytest.approx(0.5)
+    assert s["staleness_max"] == 1 and s["staleness_last_max"] == 1
+
+
+def test_staleness_accountant_validation_and_missing_version():
+    with pytest.raises(ValueError):
+        StalenessAccountant(max_staleness=-1)
+    # elements without the attribute (or None) count as version 0
+    assert StalenessAccountant.element_staleness(SimpleNamespace(), 3) == 3
+    assert StalenessAccountant.element_staleness(
+        SimpleNamespace(policy_version=None), 3
+    ) == 3
+    # a newer-than-learner version never goes negative
+    assert StalenessAccountant.element_staleness(
+        SimpleNamespace(policy_version=9), 3
+    ) == 0
+
+
+def test_importance_weights_identity_at_zero_staleness():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    log_ratio = jnp.asarray(rng.normal(scale=0.7, size=(4, 5)), jnp.float32)
+    w = staleness_importance_weights(log_ratio, jnp.zeros(4, jnp.int32), 2.0)
+    assert np.array_equal(np.asarray(w), np.ones((4, 5), np.float32))  # exact, not approx
+
+
+def test_importance_weights_clip_and_mixed_rows():
+    import jax.numpy as jnp
+
+    log_ratio = jnp.asarray([[2.0, -3.0, 0.1], [2.0, -3.0, 0.1]], jnp.float32)
+    staleness = jnp.asarray([0, 2], jnp.int32)
+    w = np.asarray(staleness_importance_weights(log_ratio, staleness, 2.0))
+    assert np.array_equal(w[0], np.ones(3, np.float32))  # fresh row untouched
+    assert w[1][0] == pytest.approx(2.0)  # exp(2) clipped down
+    assert w[1][1] == pytest.approx(0.5)  # exp(-3) clipped up
+    assert w[1][2] == pytest.approx(np.exp(0.1), rel=1e-5)
+    with pytest.raises(ValueError):
+        staleness_importance_weights(log_ratio, staleness, 0.5)
+
+
+def test_ppo_loss_bitwise_identical_at_zero_staleness():
+    import jax.numpy as jnp
+
+    from trlx_tpu.methods.ppo import PPOConfig
+
+    method = PPOConfig()
+    rng = np.random.default_rng(1)
+    B, T = 4, 6
+
+    def arr(scale=1.0):
+        return jnp.asarray(rng.normal(scale=scale, size=(B, T)), jnp.float32)
+
+    mask = jnp.asarray(rng.integers(0, 2, size=(B, T)), jnp.float32)
+    kwargs = dict(
+        logprobs=arr(0.5), values=arr(), old_logprobs=arr(0.5), old_values=arr(),
+        advantages=arr(), returns=arr(), mask=mask,
+    )
+    loss_vanilla, stats_vanilla = method.loss(**kwargs)
+    loss_zero, stats_zero = method.loss(
+        staleness=jnp.zeros(B, jnp.int32), is_ratio_clip=2.0, **kwargs
+    )
+    # acceptance criterion: the corrected program on on-policy data is bitwise
+    # identical to the vanilla loss (jnp.where picks exactly 1.0 weights)
+    assert np.asarray(loss_vanilla).tobytes() == np.asarray(loss_zero).tobytes()
+    assert np.asarray(stats_vanilla["losses"]["policy_loss"]).tobytes() == \
+        np.asarray(stats_zero["losses"]["policy_loss"]).tobytes()
+    assert "staleness" not in stats_vanilla and "staleness" in stats_zero
+    loss_stale, stats_stale = method.loss(
+        staleness=jnp.ones(B, jnp.int32), is_ratio_clip=2.0, **kwargs
+    )
+    assert float(loss_stale) != float(loss_vanilla)  # stale rows reweighted
+    assert float(stats_stale["staleness"]["mean"]) == 1.0
+
+
+# ----------------------------------------------------------------- engine
+
+
+def build_engine(produce_fn, capacity=16, max_staleness=8, **queue_kwargs):
+    pub = ParameterPublisher(copy_fn=dict)
+    pub.publish({"step": 0})
+    q = ExperienceQueue(capacity, **queue_kwargs)
+    acc = StalenessAccountant(max_staleness)
+    return AsyncRolloutEngine(produce_fn, pub, q, acc), pub, q, acc
+
+
+def test_engine_produces_tags_and_observes_staleness():
+    counter = {"n": 0}
+
+    def produce(params, version):
+        counter["n"] += 1
+        return [make_element(counter["n"])]
+
+    engine, pub, q, acc = build_engine(produce, capacity=8, high_watermark=4)
+    engine.start()
+    try:
+        first = engine.collect(2, learner_version=0, timeout=10.0)
+        assert len(first) == 2
+        assert all(int(e.policy_version) == 0 for e in first)
+        # wait for a v0 backlog to build, then publish: those queued elements
+        # become observably stale, exactly like a learner step mid-production
+        deadline = time.monotonic() + 10.0
+        while q.qsize() < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert q.qsize() >= 2
+        pub.publish({"step": 1})
+        learner_version = pub.publish({"step": 2})
+        batch = engine.collect(4, learner_version=learner_version, timeout=10.0)
+        staleness = [
+            StalenessAccountant.element_staleness(e, learner_version) for e in batch
+        ]
+        assert max(staleness) > 0  # async: consumed experience lags the learner
+        # elements produced after the publish carry the new version
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            e = engine.collect(1, learner_version=learner_version, timeout=10.0)[0]
+            if int(e.policy_version) == learner_version:
+                break
+        else:
+            pytest.fail("producer never picked up the published snapshot")
+    finally:
+        summary = engine.stop(timeout=10.0)
+    assert not engine.running
+    assert q.closed
+    assert summary["peak_queue_depth"] <= q.capacity
+    assert summary["produced"] >= summary["consumed"]
+    assert 0.0 <= summary["overlap_fraction"] <= 1.0
+
+
+def test_engine_collect_drops_stale_and_refills():
+    def produce(params, version):
+        return [make_element(0)]
+
+    engine, pub, q, acc = build_engine(produce, max_staleness=1)
+    engine.start()
+    try:
+        # bump learner far ahead: everything at version 0 now exceeds the cap...
+        for _ in range(3):
+            learner_version = pub.publish({})
+        # ...until the producer re-reads the snapshot; collect must drop the
+        # stale tail and keep pulling until it has n admitted elements
+        batch = engine.collect(2, learner_version=learner_version, timeout=15.0)
+        assert len(batch) == 2
+        assert all(
+            StalenessAccountant.element_staleness(e, learner_version) <= 1
+            for e in batch
+        )
+        assert acc.stats()["dropped_stale"] >= 0
+    finally:
+        engine.stop(timeout=10.0)
+
+
+def test_engine_producer_crash_surfaces_in_collect_and_stop():
+    def produce(params, version):
+        raise RuntimeError("synthetic producer failure")
+
+    engine, pub, q, acc = build_engine(produce)
+    engine.start()
+    with pytest.raises(RuntimeError, match="producer died"):
+        engine.collect(1, learner_version=0, timeout=10.0)
+    with pytest.raises(RuntimeError, match="producer died"):
+        engine.stop(timeout=10.0)
+    assert not engine.running and q.closed
+
+
+def test_engine_holds_pause_lock_during_produce():
+    observed = {}
+
+    def produce(params, version):
+        # the producer must hold the pause lock across the produce call so
+        # evaluate() can exclude itself from the shared tokenizer/RNG/caches
+        observed["locked"] = engine._pause_lock.locked()
+        return [make_element(0)]
+
+    engine, pub, q, acc = build_engine(produce)
+    engine.start()
+    try:
+        engine.collect(1, learner_version=0, timeout=10.0)
+        assert observed["locked"] is True
+    finally:
+        engine.stop(timeout=10.0)
+    with engine.paused():  # usable (and exclusive) after shutdown too
+        pass
+
+
+def test_engine_collect_timeout():
+    never = threading.Event()
+
+    def produce(params, version):
+        never.wait(30.0)
+        return []
+
+    engine, pub, q, acc = build_engine(produce)
+    engine.start()
+    try:
+        with pytest.raises(TimeoutError):
+            engine.collect(1, learner_version=0, timeout=0.3)
+    finally:
+        never.set()
+        engine.stop(timeout=10.0)
+
+
+# ----------------------------------------------------------------- config
+
+
+def test_async_rollout_config_roundtrip_and_dotted_update():
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.data.default_configs import default_ppo_config
+
+    config = default_ppo_config()
+    assert config.train.async_rollouts.enabled is False  # sync stays the default
+    d = config.to_dict()
+    assert d["train"]["async_rollouts"]["max_staleness"] == 1
+    assert TRLConfig.from_dict(d).to_dict() == d
+
+    new = TRLConfig.update(
+        d, {"train.async_rollouts.enabled": True, "train.async_rollouts.max_staleness": 3}
+    )
+    assert new.train.async_rollouts.enabled is True
+    assert new.train.async_rollouts.max_staleness == 3
+    with pytest.raises(ValueError):
+        TRLConfig.update(d, {"train.async_rollouts.bogus_knob": 1})
+
+
+# ------------------------------------------------- storage / tracker / logging
+
+
+def test_rollout_storage_concurrent_push():
+    from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
+
+    store = PPORolloutStorage(pad_token_id=0)
+    n_threads, per_thread = 8, 50
+
+    def pusher(t):
+        for i in range(per_thread):
+            store.push([make_element(t * per_thread + i)])
+
+    threads = [threading.Thread(target=pusher, args=(t,)) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10.0)
+    assert len(store) == n_threads * per_thread
+    store.clear_history()
+    assert len(store) == 0
+
+
+def test_jsonl_tracker_flush_and_fsync_on_finish(tmp_path):
+    from trlx_tpu.utils.trackers import JsonlTracker
+
+    tracker = JsonlTracker(str(tmp_path), "run", config={"seed": 2})
+    tracker.log({"loss": 1.5, "skipme": object()}, step=0)
+    tracker.log({"loss": np.float32(0.5)}, step=1)
+    # per-record flush: the file is complete even before finish()
+    with open(tracker.path) as f:
+        lines = [json.loads(line) for line in f]
+    assert len(lines) == 3 and lines[0]["_config"] == {"seed": 2}
+    assert lines[2]["loss"] == 0.5 and "skipme" not in lines[1]
+    tracker.finish()
+    tracker.finish()  # idempotent on a closed file
+    with open(tracker.path) as f:
+        assert len(f.readlines()) == 3
+
+
+def test_setup_rollout_logging_creates_missing_dirs(tmp_path):
+    from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+
+    # regression: the old code asserted os.path.isdir(parent) and raced mkdir;
+    # a missing parent dir must simply be created
+    base = tmp_path / "not" / "yet" / "there"
+    config = SimpleNamespace(
+        train=SimpleNamespace(rollout_logging_dir=str(base)),
+        to_dict=lambda: {"train": {"rollout_logging_dir": str(base)}},
+    )
+    stub = SimpleNamespace()
+    PPOTrainer.setup_rollout_logging(stub, config)
+    assert os.path.isdir(stub.rollout_logging_dir)
+    assert os.path.isfile(os.path.join(stub.rollout_logging_dir, "config.json"))
+    # pre-existing dirs are fine too (crashed-run leftovers)
+    PPOTrainer.setup_rollout_logging(stub, config)
+
+
+def test_gauge_registry_thread_safe_snapshot():
+    from trlx_tpu.utils.metrics import GaugeRegistry
+
+    g = GaugeRegistry()
+    g.set("rollout/queue_depth", 3.0)
+    g.inc("rollout/produced", 2.0)
+    g.inc("rollout/produced", 1.0)
+    g.set("other/metric", 9.0)
+    snap = g.snapshot("rollout/")
+    assert snap == {"rollout/queue_depth": 3.0, "rollout/produced": 3.0}
+    assert g.get("other/metric") == 9.0
+    g.clear()
+    assert g.snapshot() == {}
+
+
+# ------------------------------------------------------------- end-to-end
+
+
+@pytest.mark.slow
+def test_async_ppo_end_to_end(tmp_path):
+    """Tiny async PPO run: learner consumes experience with observed staleness,
+    the queue honors its bound, and the producer shuts down cleanly."""
+    import trlx_tpu
+    from tests.test_trainers import TINY_MODEL, base_kwargs, dog_reward
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.methods.ppo import PPOConfig
+
+    del TINY_MODEL  # imported for parity with test_trainers; base_kwargs embeds it
+    kwargs = base_kwargs(tmp_path, "PPOTrainer", total_steps=4)
+    kwargs["train"].async_rollouts.enabled = True
+    kwargs["train"].async_rollouts.max_staleness = 4
+    kwargs["train"].async_rollouts.queue_capacity = 32
+    config = TRLConfig(
+        method=PPOConfig(
+            num_rollouts=8, chunk_size=4, ppo_epochs=2, init_kl_coef=0.01,
+            target=None, gen_kwargs=dict(max_new_tokens=6, do_sample=True, top_k=0, top_p=1.0),
+        ),
+        **kwargs,
+    )
+    trainer = trlx_tpu.train(
+        reward_fn=dog_reward,
+        prompts=["ab", "cd ef", "gh", "a b c"] * 2,
+        eval_prompts=["ab", "cd"],
+        config=config,
+    )
+    assert trainer.iter_count >= 4
+    assert trainer._engine is None  # on_learn_end tore the engine down
+    assert not any(t.name == "rollout-producer" and t.is_alive() for t in threading.enumerate())

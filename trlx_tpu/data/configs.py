@@ -227,6 +227,51 @@ class MeshConfig:
 
 
 @dataclass
+class AsyncRolloutConfig:
+    """Disaggregated generation/learning (``trlx_tpu/rollout``; docs/rollout.md).
+
+    When enabled, PPO experience generation runs on a continuously-producing
+    background engine decoupled from the optimizer loop through a bounded
+    queue, with versioned parameter snapshots and staleness-aware admission +
+    importance-weight correction. Synchronous rollouts stay the default;
+    ``max_staleness=0`` (or a multi-process run) falls back to them exactly.
+
+    :param enabled: turn the async engine on (PPO only).
+    :param max_staleness: cap (in policy versions, i.e. parameter publishes)
+        on how stale consumed experience may be; staler elements are dropped
+        at collection. 0 = fully on-policy = synchronous fallback.
+    :param queue_capacity: hard bound on queued experience elements; defaults
+        to ``4 * method.num_rollouts`` when None.
+    :param high_watermark / low_watermark: producer gating hysteresis — above
+        ``high`` production pauses until the learner drains to ``low``.
+        Default: capacity and capacity // 2.
+    :param publish_interval: optimizer steps between parameter publishes (each
+        publish is one donate-free device copy and bumps the policy version).
+    :param staleness_correction: apply the clipped per-token IS correction to
+        the PPO policy loss for stale samples (exact no-op at staleness 0).
+    :param is_ratio_clip: clip for the IS weights, ``[1/c, c]``.
+    :param collect_timeout_s: learner-side timeout waiting for the producer to
+        deliver a full experience batch (surfaces a wedged producer).
+    :param drain_timeout_s: shutdown timeout joining the producer thread.
+    """
+
+    enabled: bool = False
+    max_staleness: int = 1
+    queue_capacity: Optional[int] = None
+    high_watermark: Optional[int] = None
+    low_watermark: Optional[int] = None
+    publish_interval: int = 1
+    staleness_correction: bool = True
+    is_ratio_clip: float = 2.0
+    collect_timeout_s: float = 600.0
+    drain_timeout_s: float = 30.0
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        return cls(**config)
+
+
+@dataclass
 class TrainConfig:
     """Training loop hyperparameters (parity: ``TrainConfig``, configs.py:10-120 in reference).
 
@@ -272,6 +317,10 @@ class TrainConfig:
     reward_only_on_last: bool = False
     rollout_logging_dir: Optional[str] = None
 
+    # Async rollout engine (disaggregated generation/learning with a bounded
+    # experience queue and staleness-aware PPO) — see AsyncRolloutConfig.
+    async_rollouts: "AsyncRolloutConfig" = field(default_factory=lambda: AsyncRolloutConfig())
+
     # score with reward_fn on process 0 only and broadcast the results to every
     # host. None (default) = auto: ON exactly when jax.process_count() > 1 —
     # otherwise every host hits a served reward model with identical requests
@@ -300,6 +349,10 @@ class TrainConfig:
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):
+        config = dict(config)
+        ar = config.get("async_rollouts")
+        if isinstance(ar, dict):
+            config["async_rollouts"] = AsyncRolloutConfig.from_dict(ar)
         return cls(**config)
 
 

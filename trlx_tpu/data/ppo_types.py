@@ -9,18 +9,30 @@ import flax.struct
 @flax.struct.dataclass
 class PPORLElement:
     """One rollout: query (prompt) tokens, response tokens, and per-response-token
-    logprobs / values / rewards (KL-penalized, score at last token)."""
+    logprobs / values / rewards (KL-penalized, score at last token).
+
+    ``policy_version`` tags which published parameter snapshot sampled this
+    element (async rollout engine, ``trlx_tpu/rollout``); the synchronous path
+    leaves it at 0 and staleness is always computed relative to the learner's
+    current version."""
 
     query_tensor: Any  # [P]
     response_tensor: Any  # [R]
     logprobs: Any  # [R]
     values: Any  # [R]
     rewards: Any  # [R]
+    policy_version: Any = 0  # scalar int
 
 
 @flax.struct.dataclass
 class PPORLBatch:
-    """Collated rollouts: queries left-padded, responses right-padded."""
+    """Collated rollouts: queries left-padded, responses right-padded.
+
+    ``policy_version`` carries the per-sample sampling version from collate;
+    ``staleness`` (learner_version - policy_version, [B] int32) is filled in by
+    the trainer right before the train step when staleness correction is on —
+    it cannot be baked at collate time because the learner keeps publishing
+    while collated batches wait their turn."""
 
     query_tensors: Any  # [B, P]
     response_tensors: Any  # [B, R]
@@ -29,3 +41,5 @@ class PPORLBatch:
     rewards: Any  # [B, R]
     attention_mask: Any  # [B, P] mask for queries
     response_mask: Any  # [B, R] mask for responses
+    policy_version: Any = None  # [B] int32
+    staleness: Any = None  # [B] int32

@@ -152,9 +152,18 @@ class PPOConfig(MethodConfig):
         advantages: jnp.ndarray,
         returns: jnp.ndarray,
         mask: jnp.ndarray,
+        staleness: Optional[jnp.ndarray] = None,
+        is_ratio_clip: Optional[float] = None,
     ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
         """Clipped PPO policy + value loss with the reference's stats dict
-        (modeling_ppo.py:175-238). All inputs are [B, T_resp]-shaped and masked."""
+        (modeling_ppo.py:175-238). All inputs are [B, T_resp]-shaped and masked.
+
+        With ``staleness`` ([B] policy-version lag from the async rollout
+        engine) and ``is_ratio_clip`` both set, the policy term of stale
+        samples is reweighted by clipped per-token importance weights against
+        the behavior-policy ``old_logprobs`` (docs/rollout.md). Weights are
+        exactly 1.0 at staleness 0, keeping on-policy losses bitwise-identical
+        to the vanilla path."""
         mask = mask.astype(values.dtype)
         n = jnp.maximum(mask.sum(), 1.0)
 
@@ -170,6 +179,16 @@ class PPOConfig(MethodConfig):
         ratio = jnp.exp(log_ratio)
         # k3 estimator of approximate KL: mean(exp(-lr) - 1 + lr)
         approx_kl = jnp.sum((jnp.exp(-log_ratio) - 1.0 + log_ratio) * mask) / n
+
+        is_weights = None
+        if staleness is not None and is_ratio_clip is not None:
+            from trlx_tpu.rollout.staleness import staleness_importance_weights
+
+            # reweight the surrogate's advantages (w > 0 commutes with the
+            # clipped max below); stop-gradient inside keeps this a fixed
+            # per-token correction, not a second policy-gradient path
+            is_weights = staleness_importance_weights(log_ratio, staleness, is_ratio_clip)
+            advantages = advantages * is_weights
 
         pg_loss1 = -advantages * ratio
         pg_loss2 = -advantages * jnp.clip(ratio, 1.0 - self.cliprange, 1.0 + self.cliprange)
@@ -199,4 +218,10 @@ class PPOConfig(MethodConfig):
             ratio=jnp.sum(ratio * mask) / n,
             padding_percentage=1.0 - n / mask.size,
         )
+        if is_weights is not None:
+            stats["staleness"] = dict(
+                mean=jnp.mean(staleness.astype(jnp.float32)),
+                max=jnp.max(staleness),
+                is_weight_mean=jnp.sum(is_weights * mask) / n,
+            )
         return loss, stats

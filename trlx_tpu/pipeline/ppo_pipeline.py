@@ -4,6 +4,7 @@ into :class:`PPORLBatch`, and JSON export for algorithm distillation."""
 
 import json
 import os
+import threading
 import time
 from typing import Iterable, List
 
@@ -30,23 +31,38 @@ def ppo_collate_fn(pad_token_id: int, elems: List[PPORLElement]) -> PPORLBatch:
     logprobs = pad_collate_f32([e.logprobs for e in elems], R)
     values = pad_collate_f32([e.values for e in elems], R)
     rewards = pad_collate_f32([e.rewards for e in elems], R)
+    versions = np.asarray(
+        [int(getattr(e, "policy_version", 0) or 0) for e in elems], np.int32
+    )
 
-    return PPORLBatch(queries, responses, logprobs, values, rewards, q_mask, r_mask)
+    return PPORLBatch(
+        queries, responses, logprobs, values, rewards, q_mask, r_mask,
+        policy_version=versions,
+    )
 
 
 class PPORolloutStorage(BaseRolloutStore):
-    """Rollout storage for PPO experience."""
+    """Rollout storage for PPO experience.
+
+    Mutations are lock-guarded: with the async rollout engine the producer
+    thread and the learner can touch the store concurrently (push vs
+    clear_history/iteration), and ``history`` swaps must be atomic against a
+    mid-``export_history`` snapshot."""
 
     def __init__(self, pad_token_id: int):
         super().__init__()
         self.pad_token_id = pad_token_id
         self.history: List[PPORLElement] = []
+        self._lock = threading.RLock()
 
     def push(self, exps: Iterable[PPORLElement]):
-        self.history += list(exps)
+        exps = list(exps)
+        with self._lock:
+            self.history = self.history + exps
 
     def clear_history(self):
-        self.history = []
+        with self._lock:
+            self.history = []
 
     def export_history(self, location: str, only_text: bool = False, tokenizer=None):
         """Append rollouts as JSON for algorithm distillation
@@ -69,15 +85,19 @@ class PPORolloutStorage(BaseRolloutStore):
                     d = {"query_text": d["query_text"], "response_text": d["response_text"]}
             return d
 
-        data = [exp_to_dict(exp) for exp in self.history]
+        with self._lock:
+            history = self.history
+        data = [exp_to_dict(exp) for exp in history]
         with open(fpath, "w") as f:
             json.dump(data, f)
 
     def __getitem__(self, index: int) -> PPORLElement:
-        return self.history[index]
+        with self._lock:
+            return self.history[index]
 
     def __len__(self) -> int:
-        return len(self.history)
+        with self._lock:
+            return len(self.history)
 
     def create_loader(self, batch_size: int, shuffle: bool = False, drop_last: bool = True,
                       seed: int = 0) -> NumpyLoader:

@@ -1,0 +1,38 @@
+"""Async rollout subsystem: disaggregated experience generation / PPO learning.
+
+The PPO hot loop is two phases — ``make_experience`` (decode-bound) and the
+optimizer epochs (FLOP-bound) — and running them strictly alternately idles the
+generator for the whole learn phase. This package turns experience generation
+into a continuously-running *producer* decoupled from the learner through a
+bounded queue, with explicit off-policy staleness control (the OPPO /
+LlamaRL-style pipelined-rollout design; see docs/rollout.md):
+
+- :mod:`trlx_tpu.rollout.queue` — bounded thread-safe experience queue with
+  backpressure, high/low watermark hysteresis, and drain-on-shutdown.
+- :mod:`trlx_tpu.rollout.publisher` — versioned parameter snapshots (monotonic
+  policy version; donate-free device copies) so the producer samples with
+  version *v* while the learner optimizes toward *v+1*.
+- :mod:`trlx_tpu.rollout.staleness` — staleness accounting, the
+  ``max_staleness`` admission cap, and the clipped per-token importance-weight
+  correction applied inside the PPO loss.
+- :mod:`trlx_tpu.rollout.engine` — the producer loop wrapping the trainer's
+  jitted generate/score pipeline, tagging every element with the policy
+  version it was sampled from.
+
+Enabled via ``TrainConfig.async_rollouts``; the synchronous path stays the
+default and ``max_staleness=0`` falls back to it exactly.
+"""
+
+from trlx_tpu.rollout.engine import AsyncRolloutEngine
+from trlx_tpu.rollout.publisher import ParameterPublisher
+from trlx_tpu.rollout.queue import ExperienceQueue, QueueClosed
+from trlx_tpu.rollout.staleness import StalenessAccountant, staleness_importance_weights
+
+__all__ = [
+    "AsyncRolloutEngine",
+    "ExperienceQueue",
+    "ParameterPublisher",
+    "QueueClosed",
+    "StalenessAccountant",
+    "staleness_importance_weights",
+]

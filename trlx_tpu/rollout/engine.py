@@ -1,0 +1,197 @@
+"""The async rollout producer loop.
+
+One daemon thread runs ``produce_fn(params, version) -> [PPORLElement]`` — the
+trainer's existing jitted generate → reward → score chunk pipeline,
+parameterized by a published parameter snapshot — in a loop:
+
+    snapshot = publisher.latest()        # freshest policy the learner published
+    elements = produce_fn(*snapshot)     # device decode + scoring, host reward
+    tag each element with the snapshot's policy version
+    queue.put(elements)                  # blocks on backpressure / watermarks
+
+The learner, on the main thread, calls :meth:`AsyncRolloutEngine.collect` to
+pop experience, runs staleness admission, and keeps training while the
+producer refills the queue — that concurrent window is the recovered idle
+time. JAX dispatch is thread-safe; on a single controller the two threads
+interleave device work, and on disaggregated topologies the same seam lets
+the producer target separate inference chips.
+
+Coordination rules (enforced here, relied on by the trainer):
+
+- ``paused()`` grabs the same lock the producer holds across one produce
+  iteration — the trainer wraps ``evaluate()`` in it because eval shares the
+  tokenizer/RNG/generation caches with the producer.
+- A producer crash closes the queue and re-raises from ``collect``/``stop``
+  so a dead producer can never silently starve the learner.
+- ``stop()`` closes the queue (waking a blocked ``put``), joins the thread,
+  and reports drain statistics; no dangling threads after ``learn()``.
+"""
+
+import contextlib
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+from trlx_tpu.rollout.publisher import ParameterPublisher
+from trlx_tpu.rollout.queue import ExperienceQueue, QueueClosed
+from trlx_tpu.rollout.staleness import StalenessAccountant
+from trlx_tpu.utils import logging
+from trlx_tpu.utils.metrics import gauges
+
+logger = logging.get_logger(__name__)
+
+
+class AsyncRolloutEngine:
+    """Continuously-running experience producer decoupled from the learner."""
+
+    def __init__(
+        self,
+        produce_fn: Callable[[Any, int], List[Any]],
+        publisher: ParameterPublisher,
+        queue: ExperienceQueue,
+        accountant: StalenessAccountant,
+        name: str = "rollout-producer",
+    ):
+        self._produce = produce_fn
+        self.publisher = publisher
+        self.queue = queue
+        self.accountant = accountant
+        self._name = name
+        self._stop_evt = threading.Event()
+        # held by the producer across one produce iteration; evaluate() takes
+        # it to pause production while it shares tokenizer/RNG/generate caches
+        self._pause_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._busy_time = 0.0
+        self._wall_start: Optional[float] = None
+        self._produced = 0
+
+    # ---------------------------------------------------------------- lifecycle
+
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError("engine already started")
+        self._wall_start = time.monotonic()
+        self._thread = threading.Thread(target=self._loop, name=self._name, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        try:
+            while not self._stop_evt.is_set():
+                with self._pause_lock:
+                    if self._stop_evt.is_set():
+                        break
+                    version, params = self.publisher.latest()
+                    t0 = time.monotonic()
+                    elements = self._produce(params, version)
+                    self._busy_time += time.monotonic() - t0
+                    self._produced += len(elements)
+                tagged = [e.replace(policy_version=version) for e in elements]
+                # outside the pause lock: backpressure must not block evaluate()
+                self.queue.put(tagged)
+                self._export_gauges()
+        except QueueClosed:
+            pass
+        except BaseException as e:  # noqa: B036 — re-raised from collect/stop
+            self._error = e
+            logger.error(f"async rollout producer died: {type(e).__name__}: {e}")
+        finally:
+            # a dead producer must never leave the learner blocked in get()
+            self.queue.close()
+
+    def stop(self, timeout: Optional[float] = 30.0) -> dict:
+        """Close the queue, join the producer, return drain statistics."""
+        self._stop_evt.set()
+        self.queue.close()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise RuntimeError(
+                    f"rollout producer failed to stop within {timeout}s"
+                )
+            self._thread = None
+        if self._error is not None:
+            raise RuntimeError("async rollout producer died") from self._error
+        stats = self.summary()
+        stats["leftover"] = self.queue.qsize()
+        return stats
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    @contextlib.contextmanager
+    def paused(self):
+        """Hold production across a critical section (e.g. ``evaluate()``)."""
+        with self._pause_lock:
+            yield
+
+    # ----------------------------------------------------------------- learner
+
+    def collect(self, n: int, learner_version: int, timeout: Optional[float] = None) -> List[Any]:
+        """Pop ``n`` staleness-admitted elements for the learner; dropped-stale
+        elements are replaced by further pops. Raises if the producer died or
+        the queue closed before ``n`` elements could be collected."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out: List[Any] = []
+        while len(out) < n:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise TimeoutError(
+                    f"collected {len(out)}/{n} rollouts within {timeout}s "
+                    f"(queue depth {self.queue.qsize()})"
+                )
+            got = self.queue.get(n - len(out), timeout=1.0 if remaining is None else min(1.0, remaining))
+            if not got:
+                if self._error is not None:
+                    raise RuntimeError("async rollout producer died") from self._error
+                if self.queue.closed and self.queue.qsize() == 0:
+                    raise RuntimeError(
+                        f"experience queue closed after {len(out)}/{n} rollouts"
+                    )
+                continue
+            fresh, dropped = self.accountant.admit(got, learner_version)
+            if dropped:
+                logger.info(
+                    f"dropped {dropped} rollouts staler than "
+                    f"{self.accountant.max_staleness} (learner v{learner_version})"
+                )
+            out.extend(fresh)
+        self._export_gauges()
+        return out
+
+    # ------------------------------------------------------------------ metrics
+
+    def overlap_fraction(self) -> float:
+        """Fraction of engine wall-time the producer spent generating — the
+        recovered generator utilization (1.0 = fully hidden behind learning)."""
+        if self._wall_start is None:
+            return 0.0
+        wall = max(time.monotonic() - self._wall_start, 1e-9)
+        return min(1.0, self._busy_time / wall)
+
+    def summary(self) -> dict:
+        q = self.queue.stats()
+        s = self.accountant.stats()
+        return {
+            "produced": self._produced,
+            "consumed": q["total_got"],
+            "dropped_stale": s["dropped_stale"],
+            "peak_queue_depth": q["peak_depth"],
+            "overlap_fraction": self.overlap_fraction(),
+            "staleness_mean": s["staleness_mean"],
+            "staleness_max": s["staleness_max"],
+        }
+
+    def _export_gauges(self):
+        q = self.queue.stats()
+        s = self.accountant.stats()
+        gauges.set("rollout/queue_depth", float(q["depth"]))
+        gauges.set("rollout/queue_peak_depth", float(q["peak_depth"]))
+        gauges.set("rollout/queue_gated", q["gated"])
+        gauges.set("rollout/produced", float(self._produced))
+        gauges.set("rollout/dropped_stale", float(s["dropped_stale"]))
+        gauges.set("rollout/staleness_mean", float(s["staleness_last_mean"]))
+        gauges.set("rollout/staleness_max", float(s["staleness_max"]))
+        gauges.set("rollout/overlap_fraction", self.overlap_fraction())

@@ -1,0 +1,57 @@
+"""Versioned parameter snapshots for the async rollout producer.
+
+The learner's jitted train step *donates* its param buffers (real buffer reuse
+on TPU — see ``MeshRLTrainer.make_grad_accum_step``), so the producer must
+never hold a reference into the live train state: the buffers it would read
+get invalidated by the very next optimizer step. The publisher therefore takes
+a **donate-free device copy** at publish time (the ``device_copy`` pattern the
+PPO trainer already uses for its frozen KL reference) and pairs it with a
+monotonic policy version: the producer generates with version *v* while the
+learner optimizes toward *v+1*, and every experience element is tagged with
+the version it was sampled from so staleness is observable downstream.
+"""
+
+import threading
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+
+
+def _default_copy(tree):
+    """Deep copy of an array pytree (host numpy or committed jax.Arrays)."""
+    return jax.tree.map(lambda x: x.copy(), tree)
+
+
+class ParameterPublisher:
+    """Single-writer (learner) / single-reader (producer) snapshot mailbox.
+
+    ``publish`` replaces the snapshot and bumps the version; ``latest`` hands
+    back the newest ``(version, params)``. Versions are monotonic from 0.
+    """
+
+    def __init__(self, copy_fn: Optional[Callable[[Any], Any]] = None):
+        self._copy = copy_fn or _default_copy
+        self._lock = threading.Lock()
+        self._version = -1
+        self._snapshot: Any = None
+
+    def publish(self, params: Any) -> int:
+        """Snapshot ``params`` (copy happens outside the lock — it may involve
+        device work) and return the new, strictly-increasing version."""
+        snapshot = self._copy(params)
+        with self._lock:
+            self._version += 1
+            self._snapshot = snapshot
+            return self._version
+
+    def latest(self) -> Tuple[int, Any]:
+        """Newest ``(version, params)``; raises if nothing was published yet."""
+        with self._lock:
+            if self._version < 0:
+                raise RuntimeError("ParameterPublisher.latest() before first publish()")
+            return self._version, self._snapshot
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version

@@ -322,12 +322,24 @@ class MeshRLTrainer(BaseRLTrainer):
                 self._rollout_params = self._cast_rollout_params(self.params)
         return self._rollout_params
 
-    def generate(self, prompts_ids: List[np.ndarray], eval_mode: bool = False, **kwargs):
+    def generate(
+        self,
+        prompts_ids: List[np.ndarray],
+        eval_mode: bool = False,
+        params: Optional[Any] = None,
+        **kwargs,
+    ):
         """Generate continuations for a list of ragged prompt id arrays.
 
         Host side: bucket-pad prompts (left) to limit recompiles; device side: one
         compiled generate per (B, P, gen-kwargs) key. Parity:
         accelerate_base_trainer.py:256-283 (generate vs generate_eval kwargs).
+
+        ``params`` overrides the sampling parameters (the async rollout engine
+        passes a published snapshot so the producer keeps a stable behavior
+        policy while the live ``self.params`` are being donated/updated);
+        default is :meth:`generation_params` (the masters or their cached
+        low-precision rollout copy).
         """
         gen_kwargs = dict(self.generate_kwargs)
         if not eval_mode and self.generate_experience_kwargs:
@@ -381,9 +393,10 @@ class MeshRLTrainer(BaseRLTrainer):
                 )
         self.rng, sub = jax.random.split(self.rng)
         batch = mesh_lib.put_batch(self.mesh, {"ids": ids, "mask": mask})
+        gen_params = params if params is not None else self.generation_params()
         with self.mesh:
             out = self._compiled_generate[key](
-                self.generation_params(), batch["ids"], batch["mask"], sub
+                gen_params, batch["ids"], batch["mask"], sub
             )
         # seq2seq sequences are [decoder_start] + response: pad_len for decode() is 1
         return (
@@ -593,8 +606,20 @@ class MeshRLTrainer(BaseRLTrainer):
     def post_backward_callback(self):
         pass
 
+    def on_learn_end(self):
+        """Teardown hook guaranteed to run when :meth:`learn` exits (normal
+        return, early stop, or exception) — PPO uses it to drain and join the
+        async rollout producer so no thread outlives training."""
+        pass
+
     def learn(self):
         """Main training loop (parity: accelerate_base_trainer.py:518-652)."""
+        try:
+            return self._learn_loop()
+        finally:
+            self.on_learn_end()
+
+    def _learn_loop(self):
         train_config = self.config.train
         self.prepare_learning()
         self.iter_count = 0

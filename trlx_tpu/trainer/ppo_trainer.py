@@ -9,6 +9,7 @@ SPMD programs; the reference's rank-0 ``broadcast``/``scatter`` of reward scores
 placed onto the mesh with the batch.
 """
 
+import time
 from typing import Dict, List
 
 import numpy as np
@@ -31,6 +32,7 @@ from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
 from trlx_tpu.trainer import register_trainer
 from trlx_tpu.trainer.mesh_trainer import MeshRLTrainer
 from trlx_tpu.utils import infinite_loader, logging
+from trlx_tpu.utils.metrics import gauges
 from trlx_tpu.utils.modeling import RunningMoments, flatten_dict, logprobs_of_labels
 
 logger = logging.get_logger(__name__)
@@ -51,6 +53,12 @@ class PPOTrainer(MeshRLTrainer):
         self.rollout_stats: Dict[str, float] = {}
         self._score_fns = {}
         self._train_steps = {}
+
+        # async rollout engine state (trlx_tpu/rollout; resolved in
+        # prepare_learning — None means the synchronous path)
+        self._engine = None
+        self._async_cfg = None
+        self._policy_version = 0
 
         if config.train.rollout_logging_dir is not None:
             self.log_rollouts = True
@@ -343,13 +351,13 @@ class PPOTrainer(MeshRLTrainer):
 
     def setup_rollout_logging(self, config):
         import os
-
-        assert os.path.isdir(config.train.rollout_logging_dir)
         import uuid
 
         self.run_id = f"run-{uuid.uuid4()}"
         self.rollout_logging_dir = os.path.join(config.train.rollout_logging_dir, self.run_id)
-        os.mkdir(self.rollout_logging_dir)
+        # the base dir may not exist yet and a crashed run may have left the
+        # run dir behind: both are fine, never assert/mkdir-race here
+        os.makedirs(self.rollout_logging_dir, exist_ok=True)
         with open(os.path.join(self.rollout_logging_dir, "config.json"), "w") as f:
             import json
 
@@ -442,6 +450,29 @@ class PPOTrainer(MeshRLTrainer):
         )
         return self._score_fns[key]
 
+    def _generate_chunks(self, tokenizer, params=None):
+        """One device generation at decode_batch_size, split into chunk_size
+        sub-chunks for reward_fn / the scoring forward. ``params`` overrides
+        the sampling params (async producer passes a published snapshot)."""
+        batch = next(self.prompt_iterator)
+        prompts = batch["input_ids"]
+        metadata = {k: v for k, v in batch.items() if k != "input_ids"}
+        samples, resp_mask, pad_len = self.generate(prompts, eval_mode=False, params=params)
+        str_samples, str_prompts, str_outputs, out_ids = self.decode(
+            prompts, samples, pad_len, append_eos=True, response_masks=resp_mask
+        )
+        cs = self.method.chunk_size
+        subs = []
+        for i in range(0, len(prompts), cs):
+            sl = slice(i, i + cs)
+            reward_kwargs = dict(
+                samples=str_samples[sl], prompts=str_prompts[sl],
+                outputs=str_outputs[sl], tokenizer=tokenizer,
+                **{k: v[sl] for k, v in metadata.items()},
+            )
+            subs.append(((prompts[sl], out_ids[sl]), reward_kwargs))
+        return subs
+
     def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0):
         """Roll out prompts → generations → rewards → KL-penalized per-token reward
         assembly → rollout store (parity: :251-524; see SURVEY.md §3.2).
@@ -455,28 +486,6 @@ class PPOTrainer(MeshRLTrainer):
         accumulated_kl = []
         all_scores_log = []
         self.clock.tick()
-
-        def generate_chunks(tokenizer):
-            """One device generation at decode_batch_size, split into chunk_size
-            sub-chunks for reward_fn / the scoring forward."""
-            batch = next(self.prompt_iterator)
-            prompts = batch["input_ids"]
-            metadata = {k: v for k, v in batch.items() if k != "input_ids"}
-            samples, resp_mask, pad_len = self.generate(prompts, eval_mode=False)
-            str_samples, str_prompts, str_outputs, out_ids = self.decode(
-                prompts, samples, pad_len, append_eos=True, response_masks=resp_mask
-            )
-            cs = self.method.chunk_size
-            subs = []
-            for i in range(0, len(prompts), cs):
-                sl = slice(i, i + cs)
-                reward_kwargs = dict(
-                    samples=str_samples[sl], prompts=str_prompts[sl],
-                    outputs=str_outputs[sl], tokenizer=tokenizer,
-                    **{k: v[sl] for k, v in metadata.items()},
-                )
-                subs.append(((prompts[sl], out_ids[sl]), reward_kwargs))
-            return subs
 
         overlap = self.method.overlap_reward_scoring
         if overlap:
@@ -509,7 +518,7 @@ class PPOTrainer(MeshRLTrainer):
                     if generated < num_rollouts:
                         new = [
                             (chunk, pool.submit(self.reward_fn, **kw) if score_locally else None)
-                            for chunk, kw in generate_chunks(self._reward_tokenizer)
+                            for chunk, kw in self._generate_chunks(self._reward_tokenizer)
                         ]
                         generated += sum(len(chunk[0]) for chunk, _ in new)
                     else:
@@ -527,7 +536,7 @@ class PPOTrainer(MeshRLTrainer):
                     pending.extend(new)
         else:
             while len(ppo_rl_elements) < num_rollouts:
-                for chunk, reward_kwargs in generate_chunks(self.tokenizer):
+                for chunk, reward_kwargs in self._generate_chunks(self.tokenizer):
                     scores = self.call_reward_fn(**reward_kwargs)
                     self._score_and_store(chunk, scores, ppo_rl_elements, accumulated_kl, all_scores_log)
 
@@ -549,9 +558,17 @@ class PPOTrainer(MeshRLTrainer):
         # grads + optimizer state peak HBM); no-op otherwise
         self._release_ref()
 
-    def _score_and_store(self, chunk, scores, ppo_rl_elements, accumulated_kl, all_scores_log):
+    def _score_and_store(
+        self, chunk, scores, ppo_rl_elements, accumulated_kl, all_scores_log, params=None
+    ):
         """Normalize scores, run the jitted logprob/value/ref scoring forward, and
-        assemble KL-penalized PPORLElements (parity: :364-502)."""
+        assemble KL-penalized PPORLElements (parity: :364-502).
+
+        ``params`` overrides the policy used for the behavior logprob/value
+        scoring pass — the async producer passes the same published snapshot it
+        sampled with, so stored logprobs are the true behavior policy's even
+        while the learner mutates ``self.params``."""
+        policy_params = self.params if params is None else params
         prompts, out_ids = chunk
         dense = np.ndim(scores[0]) > 0
         if dense:
@@ -591,7 +608,7 @@ class PPOTrainer(MeshRLTrainer):
             )
             with self.mesh:
                 logprobs, values, ref_logprobs = score_fn(
-                    self.params, self._ref_scoring_params(), self.frozen_branch_params,
+                    policy_params, self._ref_scoring_params(), self.frozen_branch_params,
                     dbatch["q"], dbatch["qm"], dbatch["r"], dbatch["rm"],
                 )
         else:
@@ -600,7 +617,7 @@ class PPOTrainer(MeshRLTrainer):
             dbatch = mesh_lib.put_batch(self.mesh, {"seq": seq, "mask": mask})
             with self.mesh:
                 logprobs, values, ref_logprobs = score_fn(
-                    self.params, self._ref_scoring_params(), self.frozen_branch_params,
+                    policy_params, self._ref_scoring_params(), self.frozen_branch_params,
                     dbatch["seq"], dbatch["mask"],
                 )
         logprobs = np.asarray(jax.device_get(logprobs))
@@ -635,12 +652,118 @@ class PPOTrainer(MeshRLTrainer):
             )
 
 
+    # ---------------------------------------------------------- async rollouts
+
+    def _resolve_async_config(self):
+        """The effective ``train.async_rollouts`` block, or None for the
+        synchronous path. ``max_staleness=0`` means fully on-policy — exactly
+        the synchronous semantics, so we run that code path rather than an
+        async engine that must block on every publish."""
+        cfg = getattr(self.config.train, "async_rollouts", None)
+        if cfg is None or not cfg.enabled:
+            return None
+        if cfg.max_staleness <= 0:
+            logger.warning(
+                "async_rollouts.max_staleness=0 requests fully on-policy data: "
+                "running the synchronous rollout path"
+            )
+            return None
+        if jax.process_count() > 1:
+            logger.warning(
+                "async_rollouts is single-process only (cross-host reward "
+                "broadcast ordering is undefined off the main thread): "
+                "running the synchronous rollout path"
+            )
+            return None
+        return cfg
+
+    def _start_async_engine(self):
+        from trlx_tpu.rollout import (
+            AsyncRolloutEngine,
+            ExperienceQueue,
+            ParameterPublisher,
+            StalenessAccountant,
+        )
+
+        cfg = self._async_cfg
+
+        def device_copy(tree):
+            # donate-free snapshot: the train step donates self.params' buffers,
+            # so the producer must read an independent copy (same pattern as the
+            # frozen KL reference in setup_model)
+            with self.mesh:
+                return jax.jit(lambda t: jax.tree.map(lambda x: x.copy(), t))(tree)
+
+        publisher = ParameterPublisher(copy_fn=device_copy)
+        self._policy_version = publisher.publish(self.params)
+        capacity = cfg.queue_capacity or 4 * self.method.num_rollouts
+        queue = ExperienceQueue(capacity, cfg.high_watermark, cfg.low_watermark)
+        self._engine = AsyncRolloutEngine(
+            self._produce_rollout_chunk,
+            publisher,
+            queue,
+            StalenessAccountant(cfg.max_staleness),
+        )
+        self._engine.start()
+        logger.info(
+            f"async rollout engine started: queue_capacity={capacity} "
+            f"(high={queue.high_watermark}, low={queue.low_watermark}), "
+            f"max_staleness={cfg.max_staleness}, "
+            f"publish_interval={cfg.publish_interval}"
+        )
+
+    def _produce_rollout_chunk(self, params, version):
+        """PRODUCER THREAD: one decode-batch of generate → reward → score, with
+        the published snapshot as both sampling and behavior-scoring policy.
+        Runs concurrently with the learner's train steps; shares no mutable
+        state with them except the float stats below (atomic swaps under the
+        GIL) — evaluate(), which does share the tokenizer/RNG/generation
+        caches, pauses the engine around itself."""
+        elements: List[PPORLElement] = []
+        kls: List[float] = []
+        scores_log: List[float] = []
+        t0 = time.monotonic()
+        for chunk, reward_kwargs in self._generate_chunks(self.tokenizer, params=params):
+            scores = self.reward_fn(**reward_kwargs)
+            self._score_and_store(chunk, scores, elements, kls, scores_log, params=params)
+        if kls:
+            self.mean_kl = float(np.mean(kls))
+        self.rollout_stats = {
+            "rollout_scores/mean": float(np.mean(scores_log)),
+            "rollout_scores/std": float(np.std(scores_log)),
+            "rollout_scores/running_mean": float(self.running_moments.mean),
+            "rollout_scores/running_std": float(self.running_moments.std),
+            "policy/sqrt_kl": float(np.sqrt(max(self.mean_kl, 0.0))),
+            "kl_ctl_value": float(self.kl_ctl.value),
+            "time/rollout_chunk_time": time.monotonic() - t0,
+            "rollout/producer_version": float(version),
+        }
+        return elements
+
+    def _refill_store_async(self):
+        """Pull ``num_rollouts`` staleness-admitted elements from the engine
+        into the rollout store (the async analogue of make_experience)."""
+        n = self.method.num_rollouts
+        t0 = time.monotonic()
+        elements = self._engine.collect(
+            n, self._policy_version, timeout=self._async_cfg.collect_timeout_s
+        )
+        gauges.set("rollout/collect_wait_s", time.monotonic() - t0)
+        if self.log_rollouts:
+            self.store.export_history(location=self.rollout_logging_dir, tokenizer=self.tokenizer)
+        self.push_to_store(elements[:n])
+
     # ------------------------------------------------------------- train loop
 
     def prepare_learning(self):
-        self.make_experience(self.method.num_rollouts, self.iter_count)
         bs = self.config.train.batch_size
         self.num_mb = max(1, bs // (self.config.train.minibatch_size or bs))
+        self._async_cfg = self._resolve_async_config()
+        if self._async_cfg is not None:
+            self._start_async_engine()
+            self._refill_store_async()
+        else:
+            self.make_experience(self.method.num_rollouts, self.iter_count)
 
     def create_train_dataloader(self):
         """ppo_epochs passes over the current rollout store per outer epoch."""
@@ -655,6 +778,18 @@ class PPOTrainer(MeshRLTrainer):
         if key in self._train_steps:
             return self._train_steps[key]
         module, method = self.module, self.method
+
+        # staleness-aware IS correction (async engine only): the mode is fixed
+        # for the trainer's lifetime, so it needs no compile-key entry. With it
+        # OFF this traces the identical program as before — the bitwise-equal
+        # guarantee of the synchronous / max_staleness=0 path.
+        use_is = self._engine is not None and bool(self._async_cfg.staleness_correction)
+        is_clip = float(self._async_cfg.is_ratio_clip) if use_is else None
+
+        def loss_extra(mb: PPORLBatch):
+            if use_is and mb.staleness is not None:
+                return dict(staleness=mb.staleness, is_ratio_clip=is_clip)
+            return {}
 
         if self.is_seq2seq:
             start_tok = self.decoder_start_token_id
@@ -677,7 +812,7 @@ class PPOTrainer(MeshRLTrainer):
                 )
                 loss, stats = method.loss(
                     logprobs, values_pred, mb.logprobs, mb.values, advantages, returns,
-                    mb.response_mask,
+                    mb.response_mask, **loss_extra(mb),
                 )
                 return loss, flatten_dict(stats)
 
@@ -698,7 +833,7 @@ class PPOTrainer(MeshRLTrainer):
             )
             loss, stats = method.loss(
                 logprobs, values_pred, mb.logprobs, mb.values, advantages, returns,
-                mb.response_mask,
+                mb.response_mask, **loss_extra(mb),
             )
             return loss, flatten_dict(stats)
 
@@ -706,6 +841,17 @@ class PPOTrainer(MeshRLTrainer):
         return self._train_steps[key]
 
     def train_step(self, batch: PPORLBatch) -> Dict[str, float]:
+        if self._engine is not None:
+            # staleness is learner-relative and must be stamped NOW (the
+            # learner kept publishing while this collated batch waited), not
+            # at collate time
+            stale = np.maximum(
+                0, self._policy_version - np.asarray(batch.policy_version, np.int64)
+            ).astype(np.int32)
+            gauges.set("rollout/batch_staleness_mean", float(stale.mean()))
+            gauges.set("rollout/batch_staleness_max", float(stale.max()))
+            if self._async_cfg.staleness_correction:
+                batch = batch.replace(staleness=stale)
         dbatch = mesh_lib.put_batch(self.mesh, batch)
         step = self._get_train_step(
             batch.query_tensors.shape[0], batch.query_tensors.shape[1], batch.response_tensors.shape[1]
@@ -714,13 +860,50 @@ class PPOTrainer(MeshRLTrainer):
             self.params, self.opt_state, stats = step(self.params, self.opt_state, dbatch)
         out = {k: float(v) for k, v in jax.device_get(stats).items()}
         out.update(self.rollout_stats)
+        if self._engine is not None:
+            out.update(gauges.snapshot("rollout/"))
         return out
 
     def post_backward_callback(self):
-        """KL controller update per optimizer step (parity: :227-231)."""
+        """KL controller update per optimizer step (parity: :227-231); under the
+        async engine, also publish a fresh parameter snapshot so the producer's
+        next chunk samples from the newest policy."""
         self.kl_ctl.update(self.mean_kl, n_steps=self.config.train.batch_size)
+        if self._engine is not None and (
+            self.iter_count % max(1, self._async_cfg.publish_interval) == 0
+        ):
+            self._policy_version = self._engine.publisher.publish(self.params)
+            gauges.set("rollout/learner_version", float(self._policy_version))
 
     def post_epoch_callback(self, epoch: int):
-        """Discard stale rollouts and collect fresh experience (parity: :219-225)."""
+        """Discard stale rollouts and collect fresh experience (parity: :219-225).
+        Async: the producer has been filling the queue during the optimizer
+        epochs, so this usually just drains already-generated experience."""
         self.store.clear_history()
-        self.make_experience(self.method.num_rollouts, self.iter_count)
+        if self._engine is not None:
+            self._refill_store_async()
+        else:
+            self.make_experience(self.method.num_rollouts, self.iter_count)
+
+    def evaluate(self):
+        """Eval shares the tokenizer, RNG, and compiled-generate caches with the
+        rollout producer: pause the engine for the duration."""
+        if self._engine is not None and self._engine.running:
+            with self._engine.paused():
+                return super().evaluate()
+        return super().evaluate()
+
+    def on_learn_end(self):
+        """Drain and join the rollout producer (no dangling threads, whatever
+        path exited learn()). Producer errors found here are logged, not
+        raised: this runs in learn()'s finally and must not mask the original
+        exception; a producer death during training already surfaces through
+        collect()."""
+        engine, self._engine = self._engine, None
+        if engine is None:
+            return
+        try:
+            stats = engine.stop(timeout=self._async_cfg.drain_timeout_s)
+            logger.info(f"async rollout engine stopped: {stats}")
+        except Exception as e:
+            logger.warning(f"async rollout engine teardown: {type(e).__name__}: {e}")

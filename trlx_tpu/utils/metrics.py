@@ -1,4 +1,13 @@
-"""Text-overlap metrics (ROUGE) — from-scratch, zero-dependency.
+"""Metrics: text-overlap scores (ROUGE) and process-local runtime gauges.
+
+Runtime gauges (:class:`GaugeRegistry` / the module-level :data:`gauges`) are
+thread-safe named floats that background subsystems — currently the async
+rollout engine (queue depth, staleness, overlap fraction) — set from worker
+threads; the trainer merges ``gauges.snapshot()`` into its per-step stats so
+every tracker backend (wandb / tensorboard / jsonl) exports them without
+knowing about the producers.
+
+Text-overlap metrics (ROUGE) — from-scratch, zero-dependency.
 
 The reference's summarize_rlhf example publishes its only quality numbers as a
 ROUGE table computed with ``evaluate.load("rouge")``
@@ -12,8 +21,43 @@ default tokenization (lowercase, runs of [a-z0-9]) and no stemming
 """
 
 import re
+import threading
 from collections import Counter
 from typing import Dict, List, Sequence
+
+
+class GaugeRegistry:
+    """Thread-safe named float gauges (see module docstring)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values: Dict[str, float] = {}
+
+    def set(self, name: str, value: float):
+        with self._lock:
+            self._values[name] = float(value)
+
+    def inc(self, name: str, delta: float = 1.0):
+        with self._lock:
+            self._values[name] = self._values.get(name, 0.0) + float(delta)
+
+    def get(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._values.get(name, default)
+
+    def snapshot(self, prefix: str = "") -> Dict[str, float]:
+        """Copy of the current gauges (optionally filtered by name prefix)."""
+        with self._lock:
+            return {k: v for k, v in self._values.items() if k.startswith(prefix)}
+
+    def clear(self):
+        with self._lock:
+            self._values.clear()
+
+
+#: Process-global registry; subsystems set, the trainer step exports.
+gauges = GaugeRegistry()
+
 
 _TOKEN_RE = re.compile(r"[a-z0-9]+")
 

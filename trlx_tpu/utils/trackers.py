@@ -45,6 +45,8 @@ class JsonlTracker(BaseTracker):
             except (TypeError, ValueError):
                 continue
         self._f.write(json.dumps(rec) + "\n")
+        # flush per record: an async-rollout run killed mid-flight (or a
+        # preempted TPU VM) must not lose the tail of its stats
         self._f.flush()
 
     def log_table(self, name, columns, rows, step):
@@ -55,6 +57,13 @@ class JsonlTracker(BaseTracker):
         self._f.flush()
 
     def finish(self):
+        if self._f.closed:
+            return
+        try:
+            self._f.flush()
+            os.fsync(self._f.fileno())  # durable through an OS-level crash too
+        except OSError:
+            pass
         self._f.close()
 
 
