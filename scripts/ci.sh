@@ -31,5 +31,10 @@ echo "== async rollout tests (CPU)"
 # suite ran on; bounded so a queue/thread deadlock fails fast instead of hanging CI
 JAX_PLATFORMS=cpu timeout -k 10 300 \
     python -m pytest tests/test_async_rollout.py -q -m "not slow" -p no:cacheprovider
-echo "CI OK"
+
+echo "== observability tests (CPU)"
+# spans/throughput/memory/watchdog/trackers; bounded for the same reason —
+# a watchdog or tracer deadlock must fail fast, not hang CI
+JAX_PLATFORMS=cpu timeout -k 10 300 \
+    python -m pytest tests/test_obs.py tests/test_trackers.py -q -m "not slow" -p no:cacheprovider
 echo "CI OK"

@@ -1,0 +1,182 @@
+"""Tracker tests: jsonl round-trip, fallback path, tensorboard table/flush,
+wandb hardening (trlx_tpu/utils/trackers.py)."""
+
+import json
+import logging as py_logging
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from trlx_tpu.utils.trackers import (
+    BaseTracker,
+    JsonlTracker,
+    TensorboardTracker,
+    WandbTracker,
+    make_tracker,
+    rows_to_markdown,
+)
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture
+def trlx_caplog(caplog):
+    lib_logger = py_logging.getLogger("trlx_tpu")
+    lib_logger.addHandler(caplog.handler)
+    try:
+        yield caplog
+    finally:
+        lib_logger.removeHandler(caplog.handler)
+
+
+# ------------------------------------------------------------------- jsonl
+
+
+def test_jsonl_tracker_round_trip(tmp_path):
+    t = JsonlTracker(str(tmp_path), "run", config={"lr": 1e-4})
+    t.log({"loss": 0.5, "tokens": 128, "skipme": "not-a-float", "alsoskip": None}, step=1)
+    t.log_table("samples", ["prompt", "output"], [["ab", "cd"], ["e|f", "g"]], step=1)
+    t.finish()
+    t.finish()  # idempotent on a closed file
+    with open(tmp_path / "run.jsonl") as f:
+        records = [json.loads(line) for line in f]
+    assert records[0]["_config"] == {"lr": 1e-4}
+    step = records[1]
+    assert step["step"] == 1 and step["loss"] == 0.5 and step["tokens"] == 128.0
+    assert "skipme" not in step and "alsoskip" not in step  # non-floats filtered
+    table = records[2]
+    assert table["_table"] == "samples" and table["rows"][1] == ["e|f", "g"]
+
+
+def test_make_tracker_fallback_to_jsonl(tmp_path, trlx_caplog):
+    """wandb is not installed in this image: requesting it must fall back to
+    jsonl with a warning instead of killing training."""
+    from trlx_tpu.data.default_configs import default_ppo_config
+
+    assert "wandb" not in sys.modules or sys.modules["wandb"] is None
+    config = default_ppo_config()
+    config.train.tracker = "wandb"
+    config.train.run_name = "fb"
+    config.train.logging_dir = str(tmp_path)
+    with trlx_caplog.at_level(py_logging.WARNING, logger="trlx_tpu.utils.trackers"):
+        tracker = make_tracker(config.train, config.to_dict())
+    assert isinstance(tracker, JsonlTracker)
+    assert "falling back to jsonl" in trlx_caplog.text
+    tracker.log({"x": 1.0}, step=0)
+    tracker.finish()
+    assert os.path.exists(tmp_path / "fb.jsonl")
+
+    config.train.tracker = None
+    assert type(make_tracker(config.train, {})) is BaseTracker
+    config.train.tracker = "nope"
+    with pytest.raises(ValueError):
+        make_tracker(config.train, {})
+
+
+# -------------------------------------------------------------- markdown
+
+
+def test_rows_to_markdown_escapes_and_truncates():
+    md = rows_to_markdown(["a", "b"], [["x|y", "m\nn"]], max_rows=1)
+    assert "x\\|y" in md and "m n" in md  # pipes escaped, newlines flattened
+    assert md.splitlines()[1] == "| --- | --- |"
+    md2 = rows_to_markdown(["a"], [["1"], ["2"], ["3"]], max_rows=2)
+    assert "1 more rows truncated" in md2
+
+
+# ------------------------------------------------------------ tensorboard
+
+
+class _StubWriter:
+    def __init__(self):
+        self.scalars, self.texts, self.calls = [], [], []
+
+    def add_scalar(self, k, v, step):
+        self.scalars.append((k, v, step))
+
+    def add_text(self, name, text, step):
+        self.texts.append((name, text, step))
+
+    def flush(self):
+        self.calls.append("flush")
+
+    def close(self):
+        self.calls.append("close")
+
+
+def make_tb_with_stub():
+    tb = TensorboardTracker.__new__(TensorboardTracker)
+    tb.writer = _StubWriter()
+    return tb
+
+
+def test_tensorboard_log_table_renders_markdown():
+    tb = make_tb_with_stub()
+    tb.log({"loss": 0.25, "bad": "str"}, step=3)
+    assert tb.writer.scalars == [("loss", 0.25, 3)]
+    tb.log_table("samples", ["p", "o"], [["ab", "cd"]], step=3)
+    [(name, text, step)] = tb.writer.texts
+    assert name == "samples" and step == 3
+    assert text.startswith("| p | o |") and "| ab | cd |" in text
+
+
+def test_tensorboard_finish_flushes_before_close():
+    tb = make_tb_with_stub()
+    tb.finish()
+    assert tb.writer.calls == ["flush", "close"]
+    # even a flush failure must not leak the writer unclosed
+    tb2 = make_tb_with_stub()
+    tb2.writer.flush = lambda: (_ for _ in ()).throw(RuntimeError("disk full"))
+    with pytest.raises(RuntimeError):
+        tb2.finish()
+    assert tb2.writer.calls == ["close"]
+
+
+def test_tensorboard_real_writer_smoke(tmp_path):
+    pytest.importorskip("torch.utils.tensorboard")
+    tb = TensorboardTracker(str(tmp_path), "run")
+    tb.log({"loss": 1.0}, step=0)
+    tb.log_table("samples", ["p"], [["x"]], step=0)
+    tb.finish()
+    run_dir = tmp_path / "run"
+    assert any(f.startswith("events.out") for f in os.listdir(run_dir))
+
+
+# ----------------------------------------------------------------- wandb
+
+
+class _ExplodingRun:
+    def log(self, *a, **k):
+        raise ConnectionError("backend 502")
+
+    def finish(self):
+        raise ConnectionError("backend 502")
+
+
+def make_wandb_with_stub():
+    wb = WandbTracker.__new__(WandbTracker)
+    wb.run = _ExplodingRun()
+
+    class _FakeWandb:
+        @staticmethod
+        def Table(columns, rows):
+            return {"columns": columns, "rows": rows}
+
+    wb.wandb = _FakeWandb
+    return wb
+
+
+def test_wandb_log_swallows_backend_exceptions(trlx_caplog):
+    wb = make_wandb_with_stub()
+    with trlx_caplog.at_level(py_logging.WARNING, logger="trlx_tpu.utils.trackers"):
+        wb.log({"loss": 1.0}, step=7)  # must not raise
+        wb.log_table("samples", ["p"], [["x"]], step=7)
+        wb.finish()
+    text = trlx_caplog.text
+    assert "wandb log failed at step 7" in text
+    assert "wandb log_table failed at step 7" in text
+    assert "wandb finish failed" in text

@@ -272,6 +272,54 @@ class AsyncRolloutConfig:
 
 
 @dataclass
+class ObservabilityConfig:
+    """Unified observability layer (``trlx_tpu/obs``; docs/observability.md).
+
+    When enabled, the trainer times every phase with the hierarchical span
+    tracer (per-step ``time/span/*`` stats, optional Chrome-trace ``trace.json``),
+    derives tokens/sec + MFU from param count and measured step time, samples
+    device-memory gauges, keeps step-time p50/p95 histograms, and runs a stall
+    watchdog that dumps all thread stacks when the learner or rollout producer
+    stops making progress. Off (the default) adds nothing to the step path.
+
+    :param enabled: master switch for the whole layer.
+    :param trace_path: write span events as Chrome-trace-event JSON here on
+        ``learn()`` exit (viewable in chrome://tracing / Perfetto). Relative
+        paths land under the tracker logging dir. None records no events
+        (span timings are still aggregated per step).
+    :param trace_device: additionally wrap each span in
+        ``jax.profiler.TraceAnnotation`` so host spans appear as named ranges
+        in xprof profiles captured via ``train.profile_dir``.
+    :param max_trace_events: hard bound on recorded trace events (the trace
+        notes how many were dropped past it).
+    :param mfu: compute throughput/MFU stats per step.
+    :param peak_device_tflops: per-chip peak TFLOP/s for the MFU denominator.
+        None auto-detects from the device kind (TPU generations with public
+        specs); unknown kinds report model TFLOP/s but omit ``mfu``.
+    :param memory_interval: steps between device-memory samples; 0 disables.
+    :param watchdog_timeout_s: stall threshold — a warning + all-thread stack
+        dump fires when the learner step or producer publish heartbeat goes
+        this long without progress. 0 disables the watchdog. Size it well
+        above eval/compile pauses (first-step XLA compiles can take minutes).
+    :param watchdog_poll_s: watchdog poll period; None = timeout / 4.
+    """
+
+    enabled: bool = False
+    trace_path: Optional[str] = None
+    trace_device: bool = True
+    max_trace_events: int = 100_000
+    mfu: bool = True
+    peak_device_tflops: Optional[float] = None
+    memory_interval: int = 1
+    watchdog_timeout_s: float = 0.0
+    watchdog_poll_s: Optional[float] = None
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        return cls(**config)
+
+
+@dataclass
 class TrainConfig:
     """Training loop hyperparameters (parity: ``TrainConfig``, configs.py:10-120 in reference).
 
@@ -321,6 +369,10 @@ class TrainConfig:
     # experience queue and staleness-aware PPO) — see AsyncRolloutConfig.
     async_rollouts: "AsyncRolloutConfig" = field(default_factory=lambda: AsyncRolloutConfig())
 
+    # Observability layer (span tracing / throughput + MFU / memory gauges /
+    # stall watchdog) — see ObservabilityConfig and docs/observability.md.
+    observability: "ObservabilityConfig" = field(default_factory=lambda: ObservabilityConfig())
+
     # score with reward_fn on process 0 only and broadcast the results to every
     # host. None (default) = auto: ON exactly when jax.process_count() > 1 —
     # otherwise every host hits a served reward model with identical requests
@@ -353,6 +405,9 @@ class TrainConfig:
         ar = config.get("async_rollouts")
         if isinstance(ar, dict):
             config["async_rollouts"] = AsyncRolloutConfig.from_dict(ar)
+        obs = config.get("observability")
+        if isinstance(obs, dict):
+            config["observability"] = ObservabilityConfig.from_dict(obs)
         return cls(**config)
 
 

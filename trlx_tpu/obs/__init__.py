@@ -1,0 +1,47 @@
+"""Unified observability layer: span tracing, throughput/MFU accounting,
+device-memory gauges, and a stall watchdog (docs/observability.md).
+
+Four primitives, each usable standalone, plus the :class:`Observability`
+facade the trainer drives from ``TRLConfig.train.observability``:
+
+- :mod:`trlx_tpu.obs.spans` — thread-safe hierarchical span tracer;
+  ``with span("generate"):`` times phases across the learner and the rollout
+  producer thread, exports per-step aggregates, and writes Chrome-trace-event
+  JSON (``trace.json``, Perfetto-viewable).
+- :mod:`trlx_tpu.obs.throughput` — tokens/sec, samples/sec, and MFU from
+  param count + measured step time.
+- :mod:`trlx_tpu.obs.memory` — device-memory gauges from
+  ``jax.Device.memory_stats()`` (host-RSS fallback on CPU).
+- :mod:`trlx_tpu.obs.watchdog` — heartbeat monitor that dumps all Python
+  thread stacks when the learner or producer stops making progress.
+"""
+
+from trlx_tpu.obs.memory import device_memory_stats, host_rss_bytes
+from trlx_tpu.obs.runtime import Observability, batch_token_count
+from trlx_tpu.obs.spans import SpanTracer, span, tracer
+from trlx_tpu.obs.throughput import (
+    PEAK_TFLOPS_BY_DEVICE_KIND,
+    ThroughputAccountant,
+    detect_peak_tflops,
+    param_count,
+    transformer_flops_per_token,
+)
+from trlx_tpu.obs.watchdog import StallWatchdog, format_all_stacks, watchdog
+
+__all__ = [
+    "Observability",
+    "PEAK_TFLOPS_BY_DEVICE_KIND",
+    "SpanTracer",
+    "StallWatchdog",
+    "ThroughputAccountant",
+    "batch_token_count",
+    "detect_peak_tflops",
+    "device_memory_stats",
+    "format_all_stacks",
+    "host_rss_bytes",
+    "param_count",
+    "span",
+    "tracer",
+    "transformer_flops_per_token",
+    "watchdog",
+]

@@ -1,0 +1,69 @@
+"""Device-memory gauges sampled from ``jax.Device.memory_stats()``.
+
+HBM pressure is the binding constraint for most of the trainer's memory
+decisions (offloaded KL reference, donated train-step buffers, the rollout
+param copy dropped before the update phase) — but until now none of it was
+visible per step. :func:`device_memory_stats` samples every local device's
+allocator counters and reduces them to a handful of gauges:
+
+- ``mem/bytes_in_use_max_gb`` / ``mem/peak_bytes_in_use_max_gb`` — the worst
+  device's current and high-water usage (max, not mean: one full device OOMs
+  the program regardless of the others).
+- ``mem/bytes_limit_gb`` and ``mem/utilization`` — usage against the
+  allocator limit, when the backend reports one.
+
+The CPU backend returns ``memory_stats() = None``; there (and on any backend
+without allocator counters) the sampler falls back to the process RSS from
+``/proc/self/statm`` as ``mem/host_rss_gb`` so smoke runs still chart memory.
+Sampling is a host-side dict read per device — no device sync — and is rate-
+limited by ``observability.memory_interval`` in the trainer.
+"""
+
+import os
+from typing import Dict
+
+_GB = 1024.0 ** 3
+
+
+def host_rss_bytes() -> int:
+    """Resident set size of this process in bytes (0 if unreadable)."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def device_memory_stats(prefix: str = "mem/") -> Dict[str, float]:
+    """Sample local devices' memory_stats into flat gauges (see module doc)."""
+    import jax
+
+    in_use, peak, limit = [], [], []
+    for device in jax.local_devices():
+        try:
+            stats = device.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        if "bytes_in_use" in stats:
+            in_use.append(float(stats["bytes_in_use"]))
+        if "peak_bytes_in_use" in stats:
+            peak.append(float(stats["peak_bytes_in_use"]))
+        if "bytes_limit" in stats:
+            limit.append(float(stats["bytes_limit"]))
+    out: Dict[str, float] = {}
+    if in_use:
+        out[f"{prefix}bytes_in_use_max_gb"] = max(in_use) / _GB
+    if peak:
+        out[f"{prefix}peak_bytes_in_use_max_gb"] = max(peak) / _GB
+    if limit:
+        out[f"{prefix}bytes_limit_gb"] = max(limit) / _GB
+        if in_use and max(limit) > 0:
+            out[f"{prefix}utilization"] = max(in_use) / max(limit)
+    if not out:
+        rss = host_rss_bytes()
+        if rss:
+            out[f"{prefix}host_rss_gb"] = rss / _GB
+    return out

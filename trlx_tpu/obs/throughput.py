@@ -1,0 +1,127 @@
+"""Throughput and model-FLOPs-utilization (MFU) accounting.
+
+"As fast as the hardware allows" (ROADMAP) is unverifiable without a number
+for how much of the hardware each step actually used. This module derives the
+standard ones from quantities the trainer already has — parameter count,
+token counts, and measured step wall time:
+
+- **tokens/sec, samples/sec** — raw throughput.
+- **model TFLOP/s** — achieved model FLOPs per second, using the standard
+  decoder-transformer estimate (PaLM appendix B / Chinchilla accounting):
+  ``6 * N`` FLOPs per trained token (fwd 2N + bwd 4N) plus the attention
+  term ``12 * L * H * S`` per token when layer/hidden/seqlen are known;
+  generation forwards count ``2 * N (+ attention)`` per token.
+- **MFU** — achieved model FLOP/s divided by the mesh's peak FLOP/s.
+  Peak per-device FLOP/s is auto-detected from ``device_kind`` for the TPU
+  generations with public specs (bf16 numbers) and can be overridden with
+  ``observability.peak_device_tflops`` for anything the table doesn't know
+  (GPUs, CPUs in smoke runs). Unknown + no override ⇒ ``mfu`` is simply not
+  reported — never a made-up denominator.
+
+All of this is host-side float arithmetic once per step; it adds no device
+work and no synchronization.
+"""
+
+from typing import Any, Dict, Optional
+
+#: Peak dense bf16 TFLOP/s per chip by ``jax.Device.device_kind`` substring
+#: (public spec sheets; matched case-insensitively, first hit wins).
+PEAK_TFLOPS_BY_DEVICE_KIND = {
+    "tpu v5p": 459.0,
+    "tpu v5 lite": 197.0,
+    "tpu v5e": 197.0,
+    "tpu v6e": 918.0,
+    "tpu v6 lite": 918.0,
+    "tpu v4": 275.0,
+    "tpu v3": 123.0,
+    "tpu v2": 46.0,
+}
+
+
+def param_count(tree: Any) -> int:
+    """Total number of elements across a param pytree's array leaves."""
+    import jax
+
+    return int(sum(getattr(leaf, "size", 0) for leaf in jax.tree.leaves(tree)))
+
+
+def detect_peak_tflops(device_kind: str) -> Optional[float]:
+    """Per-chip peak TFLOP/s for a ``jax.Device.device_kind``, or None."""
+    kind = (device_kind or "").lower()
+    for key, tflops in PEAK_TFLOPS_BY_DEVICE_KIND.items():
+        if key in kind:
+            return tflops
+    return None
+
+
+def transformer_flops_per_token(
+    n_params: int,
+    num_layers: int = 0,
+    hidden_size: int = 0,
+    seq_len: int = 0,
+    backward: bool = True,
+) -> float:
+    """Model FLOPs to process one token: ``(2 or 6) * N`` matmul FLOPs plus the
+    attention term ``(4 or 12) * L * H * S`` (PaLM appendix B)."""
+    mult = 6.0 if backward else 2.0
+    flops = mult * float(n_params)
+    if num_layers and hidden_size and seq_len:
+        flops += (mult * 2.0) * float(num_layers) * float(hidden_size) * float(seq_len)
+    return flops
+
+
+class ThroughputAccountant:
+    """Per-step throughput/MFU stats from param count + measured step time."""
+
+    def __init__(
+        self,
+        n_params: int,
+        num_devices: int = 1,
+        peak_device_tflops: Optional[float] = None,
+        num_layers: int = 0,
+        hidden_size: int = 0,
+    ):
+        if n_params < 0:
+            raise ValueError(f"n_params must be >= 0, got {n_params}")
+        self.n_params = int(n_params)
+        self.num_devices = max(1, int(num_devices))
+        self.peak_device_tflops = peak_device_tflops
+        self.num_layers = int(num_layers)
+        self.hidden_size = int(hidden_size)
+        self.total_tokens = 0
+        self.total_samples = 0
+
+    def peak_flops(self) -> Optional[float]:
+        """Mesh-wide peak FLOP/s, or None when no peak is known."""
+        if self.peak_device_tflops is None:
+            return None
+        return self.peak_device_tflops * 1e12 * self.num_devices
+
+    def step_stats(
+        self,
+        tokens: int,
+        samples: int,
+        step_time_s: float,
+        seq_len: int = 0,
+        backward: bool = True,
+        prefix: str = "throughput/",
+    ) -> Dict[str, float]:
+        """Stats for one step that processed ``tokens`` tokens over
+        ``step_time_s`` seconds of wall clock. ``mfu`` appears only when a
+        peak FLOP/s is known (detected or configured)."""
+        dt = max(float(step_time_s), 1e-9)
+        self.total_tokens += int(tokens)
+        self.total_samples += int(samples)
+        flops = tokens * transformer_flops_per_token(
+            self.n_params, self.num_layers, self.hidden_size, seq_len, backward=backward
+        )
+        out = {
+            f"{prefix}tokens_per_sec": tokens / dt,
+            f"{prefix}samples_per_sec": samples / dt,
+            f"{prefix}model_tflops_per_sec": flops / dt / 1e12,
+            f"{prefix}total_tokens": float(self.total_tokens),
+        }
+        peak = self.peak_flops()
+        if peak:
+            out[f"{prefix}mfu"] = (flops / dt) / peak
+        return out

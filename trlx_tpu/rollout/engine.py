@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Any, Callable, List, Optional
 
+from trlx_tpu.obs import span, watchdog
 from trlx_tpu.rollout.publisher import ParameterPublisher
 from trlx_tpu.rollout.queue import ExperienceQueue, QueueClosed
 from trlx_tpu.rollout.staleness import StalenessAccountant
@@ -39,6 +40,9 @@ from trlx_tpu.utils import logging
 from trlx_tpu.utils.metrics import gauges
 
 logger = logging.get_logger(__name__)
+
+#: Watchdog heartbeat name for the producer thread (docs/observability.md).
+PRODUCER_HEARTBEAT = "rollout-producer"
 
 
 class AsyncRolloutEngine:
@@ -73,6 +77,9 @@ class AsyncRolloutEngine:
         if self._thread is not None:
             raise RuntimeError("engine already started")
         self._wall_start = time.monotonic()
+        # register the heartbeat before the first produce: a producer wedged on
+        # its very first iteration must still be detectable
+        watchdog.beat(PRODUCER_HEARTBEAT)
         self._thread = threading.Thread(target=self._loop, name=self._name, daemon=True)
         self._thread.start()
 
@@ -88,8 +95,16 @@ class AsyncRolloutEngine:
                     self._busy_time += time.monotonic() - t0
                     self._produced += len(elements)
                 tagged = [e.replace(policy_version=version) for e in elements]
-                # outside the pause lock: backpressure must not block evaluate()
-                self.queue.put(tagged)
+                # outside the pause lock: backpressure must not block evaluate().
+                # Bounded puts with heartbeats between retries: a *gated* queue
+                # (learner mid-epoch, backpressure working as designed) must not
+                # read as a producer stall to the watchdog
+                with span("queue_put"):
+                    while not self.queue.put(tagged, timeout=5.0):
+                        if self._stop_evt.is_set():
+                            break
+                        watchdog.beat(PRODUCER_HEARTBEAT)
+                watchdog.beat(PRODUCER_HEARTBEAT)
                 self._export_gauges()
         except QueueClosed:
             pass
@@ -104,18 +119,24 @@ class AsyncRolloutEngine:
         """Close the queue, join the producer, return drain statistics."""
         self._stop_evt.set()
         self.queue.close()
-        if self._thread is not None:
-            self._thread.join(timeout)
-            if self._thread.is_alive():
-                raise RuntimeError(
-                    f"rollout producer failed to stop within {timeout}s"
-                )
-            self._thread = None
-        if self._error is not None:
-            raise RuntimeError("async rollout producer died") from self._error
-        stats = self.summary()
-        stats["leftover"] = self.queue.qsize()
-        return stats
+        try:
+            if self._thread is not None:
+                self._thread.join(timeout)
+                if self._thread.is_alive():
+                    raise RuntimeError(
+                        f"rollout producer failed to stop within {timeout}s"
+                    )
+                self._thread = None
+            if self._error is not None:
+                raise RuntimeError("async rollout producer died") from self._error
+            stats = self.summary()
+            stats["leftover"] = self.queue.qsize()
+            return stats
+        finally:
+            # a finished producer must neither page the watchdog nor keep its
+            # last gauge values being exported as if still live
+            watchdog.unregister(PRODUCER_HEARTBEAT)
+            gauges.clear(prefix="rollout/")
 
     @property
     def running(self) -> bool:

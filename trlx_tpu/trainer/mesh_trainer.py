@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import optax
 
 from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.obs import Observability, batch_token_count
 from trlx_tpu.ops.generation import generate as generate_op
 from trlx_tpu.ops.generation import generate_seq2seq, left_pad_batch, pad_to_bucket
 from trlx_tpu.parallel import mesh as mesh_lib
@@ -119,6 +120,12 @@ class MeshRLTrainer(BaseRLTrainer):
                 f"/{jax.device_count()}chips:{branch}"
             ).replace("/", "_")
         self.tracker = make_tracker(config.train, config.to_dict())
+        # observability layer (span tracing / MFU / memory gauges / watchdog);
+        # a disabled config makes every obs call a near-no-op
+        obs_logging_dir = config.train.logging_dir or os.path.join(
+            config.train.checkpoint_dir, "logs"
+        )
+        self.obs = Observability(config.train.observability, obs_logging_dir)
 
     # ------------------------------------------------------------- model setup
 
@@ -394,16 +401,17 @@ class MeshRLTrainer(BaseRLTrainer):
         self.rng, sub = jax.random.split(self.rng)
         batch = mesh_lib.put_batch(self.mesh, {"ids": ids, "mask": mask})
         gen_params = params if params is not None else self.generation_params()
-        with self.mesh:
-            out = self._compiled_generate[key](
-                gen_params, batch["ids"], batch["mask"], sub
-            )
+        # the span covers dispatch + the device_get sync: decode is async until
+        # the host fetch, so timing only the dispatch would undercount wildly
+        with self.obs.span("generate"):
+            with self.mesh:
+                out = self._compiled_generate[key](
+                    gen_params, batch["ids"], batch["mask"], sub
+                )
+            sequences = np.asarray(jax.device_get(out["sequences"]))
+            response_mask = np.asarray(jax.device_get(out["response_mask"]))
         # seq2seq sequences are [decoder_start] + response: pad_len for decode() is 1
-        return (
-            np.asarray(jax.device_get(out["sequences"])),
-            np.asarray(jax.device_get(out["response_mask"])),
-            1 if is_seq2seq else P,
-        )
+        return sequences, response_mask, 1 if is_seq2seq else P
 
     def decode(
         self,
@@ -618,75 +626,95 @@ class MeshRLTrainer(BaseRLTrainer):
             return self._learn_loop()
         finally:
             self.on_learn_end()
+            # after on_learn_end: producer teardown spans still get recorded
+            self.obs.close()
 
     def _learn_loop(self):
         train_config = self.config.train
         self.prepare_learning()
         self.iter_count = 0
+        self.obs.configure_model(self.params, getattr(self, "model_config", None))
+        self.obs.beat("learner")
 
         if train_config.resume_from_checkpoint and os.path.exists(train_config.resume_from_checkpoint):
             self.load(train_config.resume_from_checkpoint)
 
-        results = self.evaluate() if getattr(self, "eval_pipeline", None) else {}
+        with self.obs.span("evaluate"):
+            results = self.evaluate() if getattr(self, "eval_pipeline", None) else {}
         self.tracker.log(results, self.iter_count)
 
         profiling = False
-        for epoch in range(train_config.epochs):
-            for batch in self.create_train_dataloader():
-                if train_config.profile_dir:
-                    if self.iter_count == train_config.profile_start_step and not profiling:
-                        jax.profiler.start_trace(train_config.profile_dir)
-                        profiling = True
-                    elif self.iter_count >= train_config.profile_end_step and profiling:
-                        jax.profiler.stop_trace()
-                        profiling = False
-                self.clock.tick()  # reset: measure train_step alone
-                # drop the rollout param copy BEFORE the step: fwd+bwd+update is
-                # the peak-memory window and the copy is stale after it anyway
-                self._rollout_params = None
-                stats = self.train_step(batch)
-                stats["time/forward_backward"] = self.clock.tick()
-                self.iter_count += 1
-                self.post_backward_callback()
+        try:
+            for epoch in range(train_config.epochs):
+                for batch in self.create_train_dataloader():
+                    if train_config.profile_dir:
+                        if self.iter_count == train_config.profile_start_step and not profiling:
+                            jax.profiler.start_trace(train_config.profile_dir)
+                            profiling = True
+                        elif self.iter_count >= train_config.profile_end_step and profiling:
+                            jax.profiler.stop_trace()
+                            profiling = False
+                    self.clock.tick()  # reset: measure train_step alone
+                    # drop the rollout param copy BEFORE the step: fwd+bwd+update is
+                    # the peak-memory window and the copy is stale after it anyway
+                    self._rollout_params = None
+                    with self.obs.span("learn"):
+                        stats = self.train_step(batch)
+                    stats["time/forward_backward"] = self.clock.tick()
+                    self.iter_count += 1
+                    self.obs.beat("learner")
+                    self.post_backward_callback()
 
-                if (
-                    train_config.checkpoint_interval
-                    and self.iter_count % train_config.checkpoint_interval == 0
-                ):
-                    subfolder = f"checkpoint_{self.iter_count:0{len(str(train_config.total_steps))}d}"
-                    self.save(os.path.join(train_config.checkpoint_dir, subfolder))
-                    self.save_pretrained(os.path.join(train_config.checkpoint_dir, "hf_model"))
+                    if (
+                        train_config.checkpoint_interval
+                        and self.iter_count % train_config.checkpoint_interval == 0
+                    ):
+                        subfolder = f"checkpoint_{self.iter_count:0{len(str(train_config.total_steps))}d}"
+                        with self.obs.span("checkpoint"):
+                            self.save(os.path.join(train_config.checkpoint_dir, subfolder))
+                            self.save_pretrained(os.path.join(train_config.checkpoint_dir, "hf_model"))
 
-                if (
-                    train_config.eval_interval
-                    and self.iter_count % train_config.eval_interval == 0
-                ) or self.iter_count >= train_config.total_steps:
-                    results = self.evaluate() if getattr(self, "eval_pipeline", None) else {}
-                    stats.update(results)
-                    if train_config.save_best and "reward/mean" in results:
-                        # under SPMD every process computes the same global reward,
-                        # replacing the reference's MAX all-reduce guard (:616-638)
-                        if results["reward/mean"] > self.best_reward:
-                            self.best_reward = results["reward/mean"]
-                            self.save(os.path.join(train_config.checkpoint_dir, "best_checkpoint"))
-                    if self._sweep_tick(results):
-                        # ASHA early stop: exit cleanly (no signals — killing a
-                        # jax process mid-TPU-claim can wedge the chip tunnel)
-                        logger.info("Sweep scheduler requested early stop")
+                    if (
+                        train_config.eval_interval
+                        and self.iter_count % train_config.eval_interval == 0
+                    ) or self.iter_count >= train_config.total_steps:
+                        with self.obs.span("evaluate"):
+                            results = self.evaluate() if getattr(self, "eval_pipeline", None) else {}
+                        self.obs.beat("learner")  # a long eval is not a stall
+                        stats.update(results)
+                        if train_config.save_best and "reward/mean" in results:
+                            # under SPMD every process computes the same global reward,
+                            # replacing the reference's MAX all-reduce guard (:616-638)
+                            if results["reward/mean"] > self.best_reward:
+                                self.best_reward = results["reward/mean"]
+                                self.save(os.path.join(train_config.checkpoint_dir, "best_checkpoint"))
+                        if self._sweep_tick(results):
+                            # ASHA early stop: exit cleanly (no signals — killing a
+                            # jax process mid-TPU-claim can wedge the chip tunnel)
+                            logger.info("Sweep scheduler requested early stop")
+                            self._report_sweep_result(results)
+                            return results
+
+                    if self.obs.enabled:
+                        tokens, samples, seq_len = batch_token_count(batch)
+                        stats.update(self.obs.step_stats(tokens, samples, seq_len))
+                    stats = {k: significant(v) if isinstance(v, float) else v for k, v in stats.items()}
+                    self.tracker.log(stats, self.iter_count)
+                    if self.iter_count % 10 == 0 or self.iter_count == 1:
+                        brief = {k: v for k, v in stats.items() if "loss" in k or "reward" in k}
+                        logger.info(f"step {self.iter_count}/{train_config.total_steps} {brief}")
+
+                    if self.iter_count >= train_config.total_steps:
+                        self.save(os.path.join(train_config.checkpoint_dir, f"checkpoint_{self.iter_count}"))
                         self._report_sweep_result(results)
                         return results
-
-                stats = {k: significant(v) if isinstance(v, float) else v for k, v in stats.items()}
-                self.tracker.log(stats, self.iter_count)
-                if self.iter_count % 10 == 0 or self.iter_count == 1:
-                    brief = {k: v for k, v in stats.items() if "loss" in k or "reward" in k}
-                    logger.info(f"step {self.iter_count}/{train_config.total_steps} {brief}")
-
-                if self.iter_count >= train_config.total_steps:
-                    self.save(os.path.join(train_config.checkpoint_dir, f"checkpoint_{self.iter_count}"))
-                    self._report_sweep_result(results)
-                    return results
-            self.post_epoch_callback(epoch)
+                self.post_epoch_callback(epoch)
+        finally:
+            # the profiler window must close however the loop exits (total_steps
+            # return, sweep early stop, or an exception mid-window) — otherwise
+            # jax.profiler.stop_trace() is never called and the trace is lost
+            if profiling:
+                jax.profiler.stop_trace()
         self._report_sweep_result(results)
         return results
 

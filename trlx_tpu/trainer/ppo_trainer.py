@@ -26,6 +26,7 @@ from trlx_tpu.models.policy import (
     branch_param_subtree,
 )
 from trlx_tpu.models.transformer import TransformerLM
+from trlx_tpu.obs import span
 from trlx_tpu.parallel import mesh as mesh_lib
 from trlx_tpu.parallel.sharding import make_param_shardings
 from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
@@ -517,7 +518,7 @@ class PPOTrainer(MeshRLTrainer):
                 while generated < num_rollouts or pending:
                     if generated < num_rollouts:
                         new = [
-                            (chunk, pool.submit(self.reward_fn, **kw) if score_locally else None)
+                            (chunk, pool.submit(self._spanned_reward_fn, **kw) if score_locally else None)
                             for chunk, kw in self._generate_chunks(self._reward_tokenizer)
                         ]
                         generated += sum(len(chunk[0]) for chunk, _ in new)
@@ -537,7 +538,8 @@ class PPOTrainer(MeshRLTrainer):
         else:
             while len(ppo_rl_elements) < num_rollouts:
                 for chunk, reward_kwargs in self._generate_chunks(self.tokenizer):
-                    scores = self.call_reward_fn(**reward_kwargs)
+                    with span("reward"):
+                        scores = self.call_reward_fn(**reward_kwargs)
                     self._score_and_store(chunk, scores, ppo_rl_elements, accumulated_kl, all_scores_log)
 
         self.mean_kl = float(np.mean(accumulated_kl))
@@ -557,6 +559,13 @@ class PPOTrainer(MeshRLTrainer):
         # offloaded ref: drop the device copy before the update phase (where
         # grads + optimizer state peak HBM); no-op otherwise
         self._release_ref()
+
+    def _spanned_reward_fn(self, **kwargs):
+        """reward_fn under a ``reward`` span (overlap path runs it on a worker
+        thread — the span keeps the RPC round-trip visible on that thread's
+        timeline)."""
+        with span("reward"):
+            return self.reward_fn(**kwargs)
 
     def _score_and_store(
         self, chunk, scores, ppo_rl_elements, accumulated_kl, all_scores_log, params=None
@@ -602,27 +611,30 @@ class PPOTrainer(MeshRLTrainer):
             r_ids[i, : len(o)] = o
             r_mask[i, : len(o)] = 1
         score_fn = self._get_score_fn(q_ids.shape[0], P, R)
-        if self.is_seq2seq:
-            dbatch = mesh_lib.put_batch(
-                self.mesh, {"q": q_ids, "qm": q_mask, "r": r_ids, "rm": r_mask}
-            )
-            with self.mesh:
-                logprobs, values, ref_logprobs = score_fn(
-                    policy_params, self._ref_scoring_params(), self.frozen_branch_params,
-                    dbatch["q"], dbatch["qm"], dbatch["r"], dbatch["rm"],
+        # the span includes the device_get: the scoring forward is async until
+        # the host fetch (same reasoning as the generate span)
+        with span("score"):
+            if self.is_seq2seq:
+                dbatch = mesh_lib.put_batch(
+                    self.mesh, {"q": q_ids, "qm": q_mask, "r": r_ids, "rm": r_mask}
                 )
-        else:
-            seq = np.concatenate([q_ids, r_ids], axis=1)
-            mask = np.concatenate([q_mask, r_mask], axis=1)
-            dbatch = mesh_lib.put_batch(self.mesh, {"seq": seq, "mask": mask})
-            with self.mesh:
-                logprobs, values, ref_logprobs = score_fn(
-                    policy_params, self._ref_scoring_params(), self.frozen_branch_params,
-                    dbatch["seq"], dbatch["mask"],
-                )
-        logprobs = np.asarray(jax.device_get(logprobs))
-        values = np.asarray(jax.device_get(values))
-        ref_logprobs = np.asarray(jax.device_get(ref_logprobs))
+                with self.mesh:
+                    logprobs, values, ref_logprobs = score_fn(
+                        policy_params, self._ref_scoring_params(), self.frozen_branch_params,
+                        dbatch["q"], dbatch["qm"], dbatch["r"], dbatch["rm"],
+                    )
+            else:
+                seq = np.concatenate([q_ids, r_ids], axis=1)
+                mask = np.concatenate([q_mask, r_mask], axis=1)
+                dbatch = mesh_lib.put_batch(self.mesh, {"seq": seq, "mask": mask})
+                with self.mesh:
+                    logprobs, values, ref_logprobs = score_fn(
+                        policy_params, self._ref_scoring_params(), self.frozen_branch_params,
+                        dbatch["seq"], dbatch["mask"],
+                    )
+            logprobs = np.asarray(jax.device_get(logprobs))
+            values = np.asarray(jax.device_get(values))
+            ref_logprobs = np.asarray(jax.device_get(ref_logprobs))
 
         # per-token KL penalty & reward assembly (parity: :457-492)
         log_ratio = (logprobs - ref_logprobs) * r_mask
@@ -724,7 +736,8 @@ class PPOTrainer(MeshRLTrainer):
         scores_log: List[float] = []
         t0 = time.monotonic()
         for chunk, reward_kwargs in self._generate_chunks(self.tokenizer, params=params):
-            scores = self.reward_fn(**reward_kwargs)
+            with span("reward"):
+                scores = self.reward_fn(**reward_kwargs)
             self._score_and_store(chunk, scores, elements, kls, scores_log, params=params)
         if kls:
             self.mean_kl = float(np.mean(kls))
@@ -745,9 +758,10 @@ class PPOTrainer(MeshRLTrainer):
         into the rollout store (the async analogue of make_experience)."""
         n = self.method.num_rollouts
         t0 = time.monotonic()
-        elements = self._engine.collect(
-            n, self._policy_version, timeout=self._async_cfg.collect_timeout_s
-        )
+        with span("queue_wait"):
+            elements = self._engine.collect(
+                n, self._policy_version, timeout=self._async_cfg.collect_timeout_s
+            )
         gauges.set("rollout/collect_wait_s", time.monotonic() - t0)
         if self.log_rollouts:
             self.store.export_history(location=self.rollout_logging_dir, tokenizer=self.tokenizer)

@@ -2,10 +2,18 @@
 
 Runtime gauges (:class:`GaugeRegistry` / the module-level :data:`gauges`) are
 thread-safe named floats that background subsystems — currently the async
-rollout engine (queue depth, staleness, overlap fraction) — set from worker
-threads; the trainer merges ``gauges.snapshot()`` into its per-step stats so
-every tracker backend (wandb / tensorboard / jsonl) exports them without
-knowing about the producers.
+rollout engine (queue depth, staleness, overlap fraction) and the obs layer
+(stall counts, step-time histograms) — set from worker threads; the trainer
+merges ``gauges.snapshot()`` into its per-step stats so every tracker backend
+(wandb / tensorboard / jsonl) exports them without knowing about the producers.
+
+Besides plain ``set``/``inc`` gauges, the registry keeps **streaming
+histograms**: ``observe(name, value)`` appends to a bounded per-name window
+and ``hist_stats(name)`` reduces it to p50/p95/max/mean/count — how step-time
+tail latency (``time/step_p95``) reaches the trackers without storing an
+unbounded series. ``clear(prefix=...)`` drops a subsystem's gauges when it
+shuts down (the rollout engine clears ``rollout/*`` so a finished producer's
+stale gauges stop being exported in later steps).
 
 Text-overlap metrics (ROUGE) — from-scratch, zero-dependency.
 
@@ -22,16 +30,20 @@ default tokenization (lowercase, runs of [a-z0-9]) and no stemming
 
 import re
 import threading
-from collections import Counter
+from collections import Counter, deque
 from typing import Dict, List, Sequence
 
 
 class GaugeRegistry:
-    """Thread-safe named float gauges (see module docstring)."""
+    """Thread-safe named float gauges + streaming histograms (see module
+    docstring)."""
 
-    def __init__(self):
+    def __init__(self, hist_window: int = 512):
         self._lock = threading.Lock()
         self._values: Dict[str, float] = {}
+        self._hists: Dict[str, deque] = {}
+        self._hist_counts: Dict[str, int] = {}
+        self.hist_window = int(hist_window)
 
     def set(self, name: str, value: float):
         with self._lock:
@@ -45,14 +57,67 @@ class GaugeRegistry:
         with self._lock:
             return self._values.get(name, default)
 
+    def observe(self, name: str, value: float):
+        """Append ``value`` to the bounded streaming histogram ``name``."""
+        with self._lock:
+            hist = self._hists.get(name)
+            if hist is None:
+                hist = self._hists[name] = deque(maxlen=self.hist_window)
+            hist.append(float(value))
+            self._hist_counts[name] = self._hist_counts.get(name, 0) + 1
+
+    def hist_stats(self, name: str) -> Dict[str, float]:
+        """p50/p95/max/mean/count over the histogram's current window (count is
+        lifetime observations, not window size). Empty dict if never observed."""
+        with self._lock:
+            hist = self._hists.get(name)
+            if not hist:
+                return {}
+            values = sorted(hist)
+            count = self._hist_counts[name]
+        n = len(values)
+        # nearest-rank percentiles: exact window members, no interpolation
+        p50 = values[min(n - 1, int(0.50 * n))]
+        p95 = values[min(n - 1, int(0.95 * n))]
+        return {
+            "p50": p50,
+            "p95": p95,
+            "max": values[-1],
+            "mean": sum(values) / n,
+            "count": float(count),
+        }
+
+    def hist_snapshot(self, prefix: str = "") -> Dict[str, float]:
+        """Flattened ``{name_p50: v, name_p95: v, name_max: v}`` for every
+        histogram under ``prefix`` — merged into per-step tracker stats."""
+        with self._lock:
+            names = [k for k in self._hists if k.startswith(prefix)]
+        out: Dict[str, float] = {}
+        for name in names:
+            stats = self.hist_stats(name)
+            for key in ("p50", "p95", "max"):
+                if key in stats:
+                    out[f"{name}_{key}"] = stats[key]
+        return out
+
     def snapshot(self, prefix: str = "") -> Dict[str, float]:
         """Copy of the current gauges (optionally filtered by name prefix)."""
         with self._lock:
             return {k: v for k, v in self._values.items() if k.startswith(prefix)}
 
-    def clear(self):
+    def clear(self, prefix: str = ""):
+        """Drop gauges and histograms under ``prefix`` ("" clears everything) —
+        called by subsystems on shutdown so their last values don't keep being
+        exported as if still live."""
         with self._lock:
-            self._values.clear()
+            if not prefix:
+                self._values.clear()
+                self._hists.clear()
+                self._hist_counts.clear()
+                return
+            for store in (self._values, self._hists, self._hist_counts):
+                for k in [k for k in store if k.startswith(prefix)]:
+                    del store[k]
 
 
 #: Process-global registry; subsystems set, the trainer step exports.

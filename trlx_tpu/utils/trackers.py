@@ -67,6 +67,24 @@ class JsonlTracker(BaseTracker):
         self._f.close()
 
 
+def rows_to_markdown(columns, rows, max_rows: int = 32) -> str:
+    """Render a sample table as a GitHub-style markdown table (pipes escaped
+    so generated text can't break the layout)."""
+
+    def cell(v):
+        return str(v).replace("|", "\\|").replace("\n", " ")
+
+    lines = [
+        "| " + " | ".join(cell(c) for c in columns) + " |",
+        "|" + " --- |" * len(columns),
+    ]
+    for row in rows[:max_rows]:
+        lines.append("| " + " | ".join(cell(v) for v in row) + " |")
+    if len(rows) > max_rows:
+        lines.append(f"\n_… {len(rows) - max_rows} more rows truncated_")
+    return "\n".join(lines)
+
+
 class TensorboardTracker(BaseTracker):
     def __init__(self, logging_dir: str, run_name: str, config=None):
         from torch.utils.tensorboard import SummaryWriter
@@ -80,11 +98,29 @@ class TensorboardTracker(BaseTracker):
             except (TypeError, ValueError):
                 continue
 
+    def log_table(self, name, columns, rows, step):
+        # tensorboard has no table primitive: render the eval sample table as
+        # markdown through add_text (the TEXT tab renders it) instead of
+        # silently dropping it
+        try:
+            self.writer.add_text(name, rows_to_markdown(columns, rows), step)
+        except Exception as e:
+            logger.warning(f"tensorboard log_table failed ({e}); table dropped")
+
     def finish(self):
-        self.writer.close()
+        # flush BEFORE close: close() alone can discard events still buffered
+        # in the writer's queue at the end of a run
+        try:
+            self.writer.flush()
+        finally:
+            self.writer.close()
 
 
 class WandbTracker(BaseTracker):
+    """wandb backend. ``log``/``log_table`` swallow backend exceptions — a
+    network hiccup mid-run must not kill training (the same contract
+    :func:`make_tracker` applies to tracker construction)."""
+
     def __init__(self, project, entity, group, name, tags, config):
         import wandb
 
@@ -95,14 +131,23 @@ class WandbTracker(BaseTracker):
         self.wandb = wandb
 
     def log(self, stats, step):
-        self.run.log(dict(stats), step=step)
+        try:
+            self.run.log(dict(stats), step=step)
+        except Exception as e:
+            logger.warning(f"wandb log failed at step {step} ({e}); stats dropped")
 
     def log_table(self, name, columns, rows, step):
-        table = self.wandb.Table(columns=columns, rows=rows)
-        self.run.log({name: table}, step=step)
+        try:
+            table = self.wandb.Table(columns=columns, rows=rows)
+            self.run.log({name: table}, step=step)
+        except Exception as e:
+            logger.warning(f"wandb log_table failed at step {step} ({e}); table dropped")
 
     def finish(self):
-        self.run.finish()
+        try:
+            self.run.finish()
+        except Exception as e:
+            logger.warning(f"wandb finish failed ({e})")
 
 
 def make_tracker(train_config, full_config: dict) -> BaseTracker:
