@@ -37,4 +37,11 @@ echo "== observability tests (CPU)"
 # a watchdog or tracer deadlock must fail fast, not hang CI
 JAX_PLATFORMS=cpu timeout -k 10 300 \
     python -m pytest tests/test_obs.py tests/test_trackers.py -q -m "not slow" -p no:cacheprovider
+
+echo "== resilience tests (CPU)"
+# checkpoint atomicity, preemption, auto-resume, retry, chaos; the budget is
+# wider than the other suites because the preemption/resume contract is proven
+# on real (tiny) trainer runs, and a wedged writer thread must still fail fast
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_resilience.py -q -m "not slow" -p no:cacheprovider
 echo "CI OK"

@@ -1,0 +1,622 @@
+"""Resilience subsystem tests (trlx_tpu/resilience; docs/resilience.md):
+atomic commit semantics, retention GC, auto-resume selection, retry/backoff
+timing + deadline, preemption handling, and chaos-injected faults end-to-end
+on tiny trainer runs over the 8-device virtual CPU mesh."""
+
+import json
+import os
+import shutil
+import signal
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+import trlx_tpu
+from trlx_tpu.data.configs import (
+    MeshConfig,
+    ModelConfig,
+    OptimizerConfig,
+    ResilienceConfig,
+    SchedulerConfig,
+    TokenizerConfig,
+    TrainConfig,
+    TRLConfig,
+)
+from trlx_tpu.methods.ppo import PPOConfig
+from trlx_tpu.methods.sft import SFTConfig
+from trlx_tpu.resilience import (
+    AsyncCheckpointWriter,
+    ChaosInjectedError,
+    ChaosMonkey,
+    PreemptionHandler,
+    RetryDeadlineExceeded,
+    RetryPolicy,
+    chaos,
+    checkpoint_step,
+    find_latest_committed,
+    gc_checkpoints,
+    is_committed,
+    mark_committed,
+    retry_call,
+    write_checkpoint,
+    write_json_atomic,
+)
+from trlx_tpu.resilience.checkpoint import COMMITTED_SENTINEL, STATE_FILE
+from trlx_tpu.utils.metrics import gauges
+
+pytestmark = pytest.mark.resilience
+
+ALPHABET = "abcdefgh "
+
+TINY_MODEL = dict(
+    vocab_size=len(ALPHABET) + 3, hidden_size=32, num_layers=2, num_heads=2,
+    intermediate_size=64, max_position_embeddings=64,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate(monkeypatch):
+    """Every test starts and ends with chaos disarmed and resilience gauges
+    cleared (chaos and gauges are process-global)."""
+    monkeypatch.delenv("TRLX_CHAOS", raising=False)
+    chaos.configure(None)
+    gauges.clear("resilience/")
+    yield
+    chaos.configure(None)
+    gauges.clear("resilience/")
+
+
+# ------------------------------------------------------------------ retry/backoff
+
+
+def test_retry_transient_failure_then_success():
+    calls, sleeps = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    policy = RetryPolicy(max_retries=3, base_delay_s=0.1, jitter=0.0)
+    assert retry_call(flaky, policy=policy, sleep=sleeps.append) == "ok"
+    assert len(calls) == 3
+    assert sleeps == [0.1, 0.2]  # exponential, no jitter
+    assert gauges.get("resilience/retries") == 2.0
+
+
+def test_retry_backoff_is_capped_and_jittered():
+    policy = RetryPolicy(base_delay_s=1.0, max_delay_s=4.0, jitter=0.5)
+
+    class FixedRng:
+        def __init__(self, u):
+            self.u = u
+
+        def random(self):
+            return self.u
+
+    # rng.random()=1.0 -> factor 1.5 (max); =0.0 -> factor 0.5 (min)
+    assert policy.delay(1, rng=FixedRng(1.0)) == pytest.approx(1.5)
+    assert policy.delay(1, rng=FixedRng(0.0)) == pytest.approx(0.5)
+    # attempt 5 would be 16s un-capped; the cap applies before jitter
+    assert policy.delay(5, rng=FixedRng(1.0)) == pytest.approx(6.0)
+
+
+def test_retry_deadline_exceeded():
+    clock = {"t": 0.0}
+
+    def fake_sleep(d):
+        clock["t"] += d
+
+    def always_fails():
+        clock["t"] += 3.0
+        raise OSError("down")
+
+    policy = RetryPolicy(max_retries=100, base_delay_s=1.0, jitter=0.0, deadline_s=10.0)
+    with pytest.raises(RetryDeadlineExceeded):
+        retry_call(always_fails, policy=policy, sleep=fake_sleep, clock=lambda: clock["t"])
+    assert clock["t"] <= 13.0  # gave up instead of sleeping past the deadline
+    assert gauges.get("resilience/retry_deadline_exceeded") == 1.0
+
+
+def test_retry_giveup_exceptions_not_retried():
+    calls = []
+
+    def missing():
+        calls.append(1)
+        raise FileNotFoundError("definitively gone")
+
+    policy = RetryPolicy(retry_on=(OSError,), giveup_on=(FileNotFoundError,))
+    with pytest.raises(FileNotFoundError):
+        retry_call(missing, policy=policy, sleep=lambda d: None)
+    assert len(calls) == 1
+
+
+def test_retry_exhaustion_raises_last_error():
+    def always_fails():
+        raise ValueError("persistent")
+
+    policy = RetryPolicy(max_retries=2, base_delay_s=0.0, jitter=0.0)
+    with pytest.raises(ValueError, match="persistent"):
+        retry_call(always_fails, policy=policy, sleep=lambda d: None)
+
+
+# ------------------------------------------------------------------------ chaos
+
+
+def test_chaos_spec_parsing_and_budgets():
+    monkey = ChaosMonkey("reward:2, hf-load:1,preempt-step:5")
+    assert monkey.armed
+    assert monkey.should_fail("reward") and monkey.should_fail("reward")
+    assert not monkey.should_fail("reward")  # budget of 2 exhausted
+    assert monkey.should_fail("hf-load") and not monkey.should_fail("hf-load")
+    assert not monkey.should_fail("checkpoint")  # never armed
+    assert not monkey.preempt_due(4)
+    assert monkey.preempt_due(5)
+    assert not monkey.preempt_due(6)  # fires exactly once
+    assert monkey.stats() == {"reward": 2, "hf-load": 1, "preempt-step": 1}
+
+
+def test_chaos_rejects_unknown_site():
+    with pytest.raises(ValueError, match="unknown site"):
+        ChaosMonkey("coffee-machine:1")
+
+
+def test_chaos_reload_from_env(monkeypatch):
+    monkeypatch.setenv("TRLX_CHAOS", "reward:1")
+    chaos.reload_from_env()
+    with pytest.raises(ChaosInjectedError):
+        chaos.fail_if_armed("reward")
+    chaos.fail_if_armed("reward")  # budget spent: no raise
+    monkeypatch.delenv("TRLX_CHAOS")
+    chaos.reload_from_env()
+    assert not chaos.armed
+
+
+# ------------------------------------------------------- atomic commit protocol
+
+
+def _tiny_trees():
+    return {"params": {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                       "b": np.zeros(3, np.float32)}}
+
+
+def test_write_checkpoint_commits_atomically(tmp_path):
+    import orbax.checkpoint as ocp
+
+    path = str(tmp_path / "checkpoint_01")
+    write_checkpoint(path, _tiny_trees(), {"iter_count": 1})
+    assert is_committed(path)
+    assert not os.path.exists(path + ".tmp")
+    with open(os.path.join(path, STATE_FILE)) as f:
+        assert json.load(f)["iter_count"] == 1
+    restored = ocp.StandardCheckpointer().restore(os.path.join(path, "params"))
+    np.testing.assert_array_equal(restored["w"], _tiny_trees()["params"]["w"])
+
+
+def test_write_checkpoint_failure_leaves_no_torn_final_dir(tmp_path):
+    path = str(tmp_path / "checkpoint_01")
+    chaos.configure("checkpoint:1")
+    with pytest.raises(ChaosInjectedError):
+        write_checkpoint(path, _tiny_trees(), {"iter_count": 1})
+    assert not os.path.exists(path)  # no final-named dir a resume could pick up
+    assert not is_committed(path)
+    # the budget is spent: the identical retry succeeds
+    write_checkpoint(path, _tiny_trees(), {"iter_count": 1})
+    assert is_committed(path)
+
+
+def test_write_json_atomic_replaces_whole_file(tmp_path):
+    path = str(tmp_path / "state.json")
+    write_json_atomic(path, {"v": 1})
+    write_json_atomic(path, {"v": 2})
+    with open(path) as f:
+        assert json.load(f) == {"v": 2}
+    assert not os.path.exists(path + ".tmp")
+
+
+def _fake_committed(dirpath, step, width=2):
+    path = os.path.join(dirpath, f"checkpoint_{step:0{width}d}")
+    os.makedirs(path)
+    write_json_atomic(os.path.join(path, STATE_FILE), {"iter_count": step})
+    mark_committed(path)
+    return path
+
+
+def test_retention_gc_keeps_newest_and_protected(tmp_path):
+    root = str(tmp_path)
+    paths = {s: _fake_committed(root, s) for s in (1, 2, 3, 4, 5)}
+    best = os.path.join(root, "best_checkpoint")
+    os.makedirs(best)
+    mark_committed(best)
+    torn = os.path.join(root, "checkpoint_09")
+    os.makedirs(torn)  # no sentinel: may be an in-flight write, must survive
+    tmp_leftover = os.path.join(root, "checkpoint_10.tmp")
+    os.makedirs(tmp_leftover)
+
+    deleted = gc_checkpoints(root, keep_last=2, protected=["best_checkpoint"])
+    assert sorted(deleted) == sorted(paths[s] for s in (1, 2, 3))
+    for s in (4, 5):
+        assert os.path.exists(paths[s])
+    assert os.path.exists(best) and os.path.exists(torn) and os.path.exists(tmp_leftover)
+
+
+def test_gc_disabled_and_missing_dir(tmp_path):
+    assert gc_checkpoints(str(tmp_path / "nope"), keep_last=3) == []
+    _fake_committed(str(tmp_path), 1)
+    assert gc_checkpoints(str(tmp_path), keep_last=0) == []
+
+
+# ------------------------------------------------------------------ auto-resume
+
+
+def test_find_latest_committed_numeric_order_skips_torn(tmp_path):
+    root = str(tmp_path)
+    # legacy unpadded name: lexicographically "checkpoint_2" > "checkpoint_10"
+    legacy = os.path.join(root, "checkpoint_2")
+    os.makedirs(legacy)
+    mark_committed(legacy)
+    newest_committed = _fake_committed(root, 10, width=1)
+    torn = os.path.join(root, "checkpoint_11")
+    os.makedirs(torn)  # newest by step but torn: must be skipped
+    os.makedirs(os.path.join(root, "checkpoint_12.tmp"))
+    os.makedirs(os.path.join(root, "best_checkpoint"))  # never a resume candidate
+
+    assert find_latest_committed(root) == newest_committed
+
+
+def test_find_latest_committed_empty_cases(tmp_path):
+    assert find_latest_committed(str(tmp_path / "missing")) is None
+    assert find_latest_committed(str(tmp_path)) is None  # exists but empty
+    torn = os.path.join(str(tmp_path), "checkpoint_01")
+    os.makedirs(torn)
+    assert find_latest_committed(str(tmp_path)) is None  # only a torn dir
+
+
+def test_checkpoint_step_parsing():
+    assert checkpoint_step("checkpoint_007") == 7
+    assert checkpoint_step("checkpoint_12") == 12
+    assert checkpoint_step("checkpoint_12.tmp") is None
+    assert checkpoint_step("best_checkpoint") is None
+    assert checkpoint_step("hf_model") is None
+
+
+def test_rng_state_roundtrip():
+    import jax
+
+    from trlx_tpu.resilience.resume import (
+        pack_np_rng,
+        pack_rng_key,
+        restore_np_rng,
+        unpack_rng_key,
+    )
+
+    key = jax.random.PRNGKey(42)
+    packed = json.loads(json.dumps(pack_rng_key(key)))  # must survive JSON
+    restored = unpack_rng_key(packed, jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(key), np.asarray(restored))
+
+    rng = np.random.default_rng(7)
+    rng.random(13)  # advance off the seed state
+    state = json.loads(json.dumps(pack_np_rng(rng)))
+    expected = rng.random(5)
+    rng2 = np.random.default_rng(0)
+    restore_np_rng(rng2, state)
+    np.testing.assert_array_equal(rng2.random(5), expected)
+
+
+# ----------------------------------------------------------------- async writer
+
+
+def test_async_writer_commits_in_background(tmp_path):
+    writer = AsyncCheckpointWriter()
+    path = str(tmp_path / "checkpoint_01")
+    writer.save(path, _tiny_trees(), {"iter_count": 1})
+    writer.wait()
+    assert is_committed(path)
+    assert writer.last_committed == os.path.abspath(path)
+    assert not writer.in_flight
+    assert gauges.get("resilience/ckpt_committed") == 1.0
+    assert gauges.get("resilience/ckpt_inflight") == 0.0
+
+
+def test_async_writer_serializes_writes_and_applies_retention(tmp_path):
+    writer = AsyncCheckpointWriter(keep_last=2, protected=["best_checkpoint"])
+    for step in (1, 2, 3, 4):
+        writer.save(str(tmp_path / f"checkpoint_{step:02d}"), _tiny_trees(), {"iter_count": step})
+    writer.close()
+    names = sorted(n for n in os.listdir(tmp_path) if n.startswith("checkpoint_"))
+    assert names == ["checkpoint_03", "checkpoint_04"]
+    assert all(is_committed(str(tmp_path / n)) for n in names)
+
+
+def test_async_writer_surfaces_background_errors(tmp_path):
+    writer = AsyncCheckpointWriter()
+    chaos.configure("checkpoint:1")
+    writer.save(str(tmp_path / "checkpoint_01"), _tiny_trees(), {"iter_count": 1})
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        writer.wait()
+    # the error is consumed: the writer keeps working afterwards
+    writer.save(str(tmp_path / "checkpoint_02"), _tiny_trees(), {"iter_count": 2}, block=True)
+    assert is_committed(str(tmp_path / "checkpoint_02"))
+
+
+# ------------------------------------------------------------------- preemption
+
+
+def test_preemption_simulate_and_grace_window():
+    handler = PreemptionHandler(grace_period_s=5.0)
+    assert not handler.preempted and handler.grace_remaining_s is None
+    handler.simulate("test")
+    assert handler.preempted and handler.reason == "test"
+    assert 0.0 < handler.grace_remaining_s <= 5.0
+    handler.simulate("second call is a no-op")
+    assert handler.reason == "test"
+
+
+def test_preemption_real_sigterm_then_handler_released():
+    handler = PreemptionHandler(grace_period_s=5.0, signals=(signal.SIGTERM,))
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        assert handler.install()
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        while not handler.preempted and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert handler.preempted
+        assert "SIGTERM" in handler.reason
+        # first signal released the trap: a second SIGTERM would now terminate
+        # hard (the SIGKILL-after-SIGTERM contract needs no special handling)
+        assert signal.getsignal(signal.SIGTERM) == prev
+        assert gauges.get("resilience/preemptions") == 1.0
+    finally:
+        handler.uninstall()
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_resilience_runtime_converts_chaos_preempt(monkeypatch):
+    from trlx_tpu.resilience import Resilience
+
+    monkeypatch.setenv("TRLX_CHAOS", "preempt-step:3")
+    res = Resilience(ResilienceConfig(enabled=True, async_checkpointing=False))
+    try:
+        assert not res.should_stop(2)
+        assert res.should_stop(3)
+        assert res.should_stop(4)  # stays latched once preempted
+        assert res.preemption.preempted
+    finally:
+        res.close()
+
+
+# --------------------------------------------------------- tiny end-to-end runs
+
+
+def _sft_config(tmp_path, total_steps=2, **train_overrides):
+    train = dict(
+        seq_length=16, epochs=4, total_steps=total_steps, batch_size=4,
+        minibatch_size=2, checkpoint_interval=2, eval_interval=100,
+        checkpoint_dir=str(tmp_path / "ckpts"),
+        pipeline="PromptPipeline", trainer="SFTTrainer", tracker=None, seed=2,
+    )
+    train.update(train_overrides)
+    return TRLConfig(
+        method=SFTConfig(gen_kwargs=dict(max_new_tokens=4)),
+        train=TrainConfig(**train),
+        model=ModelConfig(model_path="gpt2", num_layers_unfrozen=-1,
+                          model_overrides=dict(TINY_MODEL)),
+        tokenizer=TokenizerConfig(tokenizer_path=f"char://{ALPHABET}"),
+        optimizer=OptimizerConfig(name="adamw", kwargs=dict(lr=1e-3)),
+        scheduler=SchedulerConfig(name="cosine_annealing", kwargs=dict(T_max=100, eta_min=1e-3)),
+        mesh=MeshConfig(data=2, fsdp=2, model=2, compute_dtype="float32"),
+    )
+
+
+def _ppo_config(tmp_path, total_steps=12, resilience=None, **train_overrides):
+    train = dict(
+        seq_length=16, epochs=30, total_steps=total_steps, batch_size=4,
+        minibatch_size=2, checkpoint_interval=100, eval_interval=100,
+        checkpoint_dir=str(tmp_path / "ckpts"),
+        pipeline="PromptPipeline", trainer="PPOTrainer", tracker=None, seed=2,
+    )
+    train.update(train_overrides)
+    cfg = TRLConfig(
+        method=PPOConfig(
+            num_rollouts=4, chunk_size=4, ppo_epochs=1, init_kl_coef=0.01,
+            target=None,
+            gen_kwargs=dict(max_new_tokens=4, do_sample=True, top_k=0, top_p=1.0),
+        ),
+        train=TrainConfig(**train),
+        model=ModelConfig(model_path="gpt2", num_layers_unfrozen=-1,
+                          model_overrides=dict(TINY_MODEL)),
+        tokenizer=TokenizerConfig(tokenizer_path=f"char://{ALPHABET}"),
+        optimizer=OptimizerConfig(name="adamw", kwargs=dict(lr=1e-3)),
+        scheduler=SchedulerConfig(name="cosine_annealing", kwargs=dict(T_max=100, eta_min=1e-3)),
+        mesh=MeshConfig(data=2, fsdp=2, model=2, compute_dtype="float32"),
+    )
+    if resilience is not None:
+        cfg.train.resilience = resilience
+    return cfg
+
+
+SFT_SAMPLES = [["ab", "cd"], ["ef", "gh"], ["a b", "c d"], ["e f", "g h"]]
+
+
+def _reward(samples, **kwargs):
+    return [float(s.count("a")) for s in samples]
+
+
+@pytest.fixture(scope="module")
+def sft_run(tmp_path_factory):
+    """One tiny SFT run with the DEFAULT (resilience off) config — the trainer
+    and its on-disk checkpoints back several assertions below."""
+    tmp_path = tmp_path_factory.mktemp("sft_default")
+    config = _sft_config(tmp_path)
+    trainer = trlx_tpu.train(samples=SFT_SAMPLES, eval_prompts=["ab"], config=config)
+    return trainer, config
+
+
+def test_sync_save_is_atomic_with_resilience_off(sft_run):
+    trainer, config = sft_run
+    assert trainer.iter_count == 2
+    # total_steps=2 -> width 1; interval and final checkpoints share the name
+    path = os.path.join(config.train.checkpoint_dir, "checkpoint_2")
+    assert is_committed(path)
+    with open(os.path.join(path, STATE_FILE)) as f:
+        state = json.load(f)
+    assert state["iter_count"] == 2
+    assert state["rng_key"] is not None and state["np_rng_state"] is not None
+    assert not any(
+        name.endswith(".tmp") for name in os.listdir(config.train.checkpoint_dir)
+    )
+
+
+def test_load_restores_rng_and_warns_on_uncommitted(sft_run, tmp_path):
+    import jax
+
+    trainer, config = sft_run
+    src = os.path.join(config.train.checkpoint_dir, "checkpoint_2")
+    # work on a copy so the module-scoped checkpoint stays pristine
+    path = str(tmp_path / "checkpoint_2")
+    shutil.copytree(src, path)
+
+    rng_before = np.asarray(jax.device_get(trainer.rng)).copy()
+    np_state_before = trainer.np_rng.bit_generator.state
+    trainer.rng = jax.random.PRNGKey(999)
+    trainer.np_rng = np.random.default_rng(999)
+
+    os.remove(os.path.join(path, COMMITTED_SENTINEL))
+    # the library root logger doesn't propagate (no caplog): attach a handler
+    import logging as _logging
+
+    records = []
+
+    class _Capture(_logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    lib_logger = _logging.getLogger("trlx_tpu")
+    handler = _Capture(level=_logging.WARNING)
+    lib_logger.addHandler(handler)
+    try:
+        trainer.load(path)
+    finally:
+        lib_logger.removeHandler(handler)
+    assert any("_COMMITTED" in m for m in records)
+    np.testing.assert_array_equal(np.asarray(jax.device_get(trainer.rng)), rng_before)
+    assert trainer.np_rng.bit_generator.state == np_state_before
+    assert trainer.iter_count == 2
+
+
+def test_auto_resume_at_end_trains_zero_steps(sft_run, tmp_path_factory):
+    """Restarting a COMPLETED run with auto-resume must not train extra steps
+    past total_steps."""
+    _, done_config = sft_run
+    config = _sft_config(tmp_path_factory.mktemp("sft_resume"))
+    config.train.checkpoint_dir = done_config.train.checkpoint_dir
+    config.train.resilience = ResilienceConfig(enabled=True, preemption_handling=False)
+    trainer = trlx_tpu.train(samples=SFT_SAMPLES, eval_prompts=["ab"], config=config)
+    assert trainer.iter_count == 2  # restored, not retrained
+
+
+def test_missing_resume_path_raises(tmp_path):
+    config = _sft_config(tmp_path)
+    config.train.resume_from_checkpoint = str(tmp_path / "does_not_exist")
+    with pytest.raises(FileNotFoundError, match="resume_from_checkpoint"):
+        trlx_tpu.train(samples=SFT_SAMPLES, eval_prompts=["ab"], config=config)
+
+
+def test_preemption_checkpoint_and_auto_resume_e2e(tmp_path, monkeypatch):
+    """The headline contract: a chaos-delivered preemption mid-run produces a
+    committed emergency checkpoint; a fresh process (same checkpoint_dir)
+    auto-resumes from it — skipping a planted torn decoy — and continues to
+    the next preemption at the correct iter_count."""
+    res_cfg = ResilienceConfig(enabled=True, grace_period_s=60.0)
+
+    monkeypatch.setenv("TRLX_CHAOS", "preempt-step:2")
+    config = _ppo_config(tmp_path, total_steps=12, resilience=res_cfg)
+    trainer = trlx_tpu.train(
+        reward_fn=_reward, prompts=["ab", "cd", "ef", "gh"] * 2,
+        eval_prompts=["ab"], config=config,
+    )
+    assert trainer.iter_count == 2
+    ckpt_dir = config.train.checkpoint_dir
+    emergency = os.path.join(ckpt_dir, "checkpoint_02")  # padded to width 2
+    assert is_committed(emergency)
+    with open(os.path.join(emergency, STATE_FILE)) as f:
+        state = json.load(f)
+    assert state["iter_count"] == 2
+    assert state["prompt_batches_drawn"] >= 1
+
+    # mark the state so the second run provably restored THIS checkpoint
+    state["best_reward"] = 123.456
+    write_json_atomic(os.path.join(emergency, STATE_FILE), state)
+    # newer-but-torn decoy: auto-resume must skip it (no sentinel, no params)
+    os.makedirs(os.path.join(ckpt_dir, "checkpoint_03"))
+
+    monkeypatch.setenv("TRLX_CHAOS", "preempt-step:4")
+    config2 = _ppo_config(tmp_path, total_steps=12, resilience=res_cfg)
+    trainer2 = trlx_tpu.train(
+        reward_fn=_reward, prompts=["ab", "cd", "ef", "gh"] * 2,
+        eval_prompts=["ab"], config=config2,
+    )
+    assert trainer2.best_reward == 123.456  # state came from checkpoint_02
+    assert trainer2.iter_count == 4
+    second = os.path.join(ckpt_dir, "checkpoint_04")
+    assert is_committed(second)
+    with open(os.path.join(second, STATE_FILE)) as f:
+        assert json.load(f)["iter_count"] == 4
+    # every step checkpoint shares the padded width: lexicographic == chronological
+    step_names = [n for n in os.listdir(ckpt_dir) if n.startswith("checkpoint_")]
+    assert all(len(n) == len("checkpoint_02") for n in step_names)
+
+
+def test_chaos_reward_failure_retried_under_resilience(tmp_path, monkeypatch):
+    res_cfg = ResilienceConfig(
+        enabled=True, retry_base_delay_s=0.01, retry_max_delay_s=0.02,
+        preemption_handling=False,
+    )
+    monkeypatch.setenv("TRLX_CHAOS", "reward:2")
+    config = _ppo_config(tmp_path, total_steps=1, resilience=res_cfg)
+    trainer = trlx_tpu.train(
+        reward_fn=_reward, prompts=["ab", "cd", "ef", "gh"] * 2,
+        eval_prompts=["ab"], config=config,
+    )
+    assert trainer.iter_count == 1  # the transient failures did not abort the run
+    assert chaos.stats().get("reward") == 2
+    assert gauges.get("resilience/retries") >= 2.0
+
+
+def test_chaos_reward_failure_aborts_without_resilience(tmp_path, monkeypatch):
+    monkeypatch.setenv("TRLX_CHAOS", "reward:1")
+    config = _ppo_config(tmp_path, total_steps=1)
+    with pytest.raises(ChaosInjectedError):
+        trlx_tpu.train(
+            reward_fn=_reward, prompts=["ab", "cd", "ef", "gh"] * 2,
+            eval_prompts=["ab"], config=config,
+        )
+
+
+def test_hf_load_retries_chaos_fault(tmp_path):
+    """The HF checkpoint read path recovers from an injected transient fault
+    (and a second, budget-exhausted read needs no retry)."""
+    import jax.numpy as jnp
+
+    from tests.test_hf_parity import make_hf_model
+    from trlx_tpu.models.hf_loading import load_pretrained
+
+    hf_dir = str(tmp_path / "hf")
+    make_hf_model("gpt2").save_pretrained(hf_dir)
+    os.environ["TRLX_HF_LOAD_RETRY_DELAY"] = "0.01"
+    try:
+        chaos.configure("hf-load:1")
+        config, params, model_type = load_pretrained(hf_dir, {"compute_dtype": jnp.float32})
+        assert model_type == "gpt2" and params is not None
+        assert chaos.stats().get("hf-load") == 1
+        assert gauges.get("resilience/retries") >= 1.0
+    finally:
+        os.environ.pop("TRLX_HF_LOAD_RETRY_DELAY", None)

@@ -320,6 +320,62 @@ class ObservabilityConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Fault tolerance for preemptible TPU runs (``trlx_tpu/resilience``;
+    docs/resilience.md).
+
+    When enabled, checkpoints commit asynchronously on a background thread
+    with an atomic ``_COMMITTED`` sentinel (the learner only stalls if a prior
+    write is still in flight), SIGTERM/SIGINT trigger an emergency checkpoint
+    inside the preemption grace window, a restarted job auto-resumes from the
+    newest committed checkpoint in ``checkpoint_dir`` (iter_count, RNG streams,
+    and dataloader position included), and reward_fn calls are retried with
+    exponential backoff + jitter under a wall-clock deadline. Off (the
+    default) leaves the synchronous save path byte-identical to before.
+
+    :param enabled: master switch for the whole subsystem.
+    :param async_checkpointing: commit checkpoints on a background writer
+        thread (single-process runs only; multi-host falls back to the
+        synchronous collective save with a warning).
+    :param keep_last: retention — keep the newest N step checkpoints, delete
+        older committed ones (``best_checkpoint`` and ``hf_model`` are always
+        kept). 0 keeps everything.
+    :param auto_resume: on startup, scan ``checkpoint_dir`` for the newest
+        committed checkpoint and resume from it. An explicit
+        ``train.resume_from_checkpoint`` wins over the scan.
+    :param preemption_handling: trap SIGTERM/SIGINT, write an emergency
+        checkpoint at the next step boundary, drain the rollout engine, and
+        exit cleanly. A second signal terminates immediately.
+    :param grace_period_s: assumed preemption grace window (budget for the
+        emergency checkpoint; logged if exceeded).
+    :param retry_rewards: wrap ``reward_fn`` in the retry/backoff policy below
+        — a transiently-failing reward endpoint no longer kills the run.
+    :param retry_max_retries: retries per reward call after the first attempt.
+    :param retry_base_delay_s: initial backoff; doubles per retry (max
+        ``retry_max_delay_s``), with ±50% jitter.
+    :param retry_deadline_s: total wall-clock budget across one call's
+        retries; exceeded → ``RetryDeadlineExceeded`` aborts the run (a
+        hard-down endpoint should page, not spin).
+    """
+
+    enabled: bool = False
+    async_checkpointing: bool = True
+    keep_last: int = 3
+    auto_resume: bool = True
+    preemption_handling: bool = True
+    grace_period_s: float = 30.0
+    retry_rewards: bool = True
+    retry_max_retries: int = 3
+    retry_base_delay_s: float = 0.5
+    retry_max_delay_s: float = 30.0
+    retry_deadline_s: float = 300.0
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        return cls(**config)
+
+
+@dataclass
 class TrainConfig:
     """Training loop hyperparameters (parity: ``TrainConfig``, configs.py:10-120 in reference).
 
@@ -373,6 +429,10 @@ class TrainConfig:
     # stall watchdog) — see ObservabilityConfig and docs/observability.md.
     observability: "ObservabilityConfig" = field(default_factory=lambda: ObservabilityConfig())
 
+    # Resilience subsystem (async atomic checkpointing / preemption handling /
+    # auto-resume / reward retries) — see ResilienceConfig and docs/resilience.md.
+    resilience: "ResilienceConfig" = field(default_factory=lambda: ResilienceConfig())
+
     # score with reward_fn on process 0 only and broadcast the results to every
     # host. None (default) = auto: ON exactly when jax.process_count() > 1 —
     # otherwise every host hits a served reward model with identical requests
@@ -408,6 +468,9 @@ class TrainConfig:
         obs = config.get("observability")
         if isinstance(obs, dict):
             config["observability"] = ObservabilityConfig.from_dict(obs)
+        res = config.get("resilience")
+        if isinstance(res, dict):
+            config["resilience"] = ResilienceConfig.from_dict(res)
         return cls(**config)
 
 

@@ -522,6 +522,40 @@ def init_params(config: TransformerConfig, module=None, seed: int = 0) -> Dict[s
     return module.init(jax.random.PRNGKey(seed), ids, jnp.ones((1, 2), jnp.int32))["params"]
 
 
+def _hf_load_retry_policy():
+    """Retry policy for HF checkpoint reads: transient I/O faults (NFS blips,
+    hub 5xx surfaced as OSError, injected chaos) are retried; a definitively
+    missing file is an answer and fails immediately. Budget is overridable via
+    TRLX_HF_LOAD_RETRIES for constrained CI."""
+    from trlx_tpu.resilience.chaos import ChaosInjectedError
+    from trlx_tpu.resilience.retry import RetryPolicy
+
+    return RetryPolicy(
+        max_retries=int(os.environ.get("TRLX_HF_LOAD_RETRIES", 2)),
+        base_delay_s=float(os.environ.get("TRLX_HF_LOAD_RETRY_DELAY", 1.0)),
+        max_delay_s=15.0,
+        retry_on=(OSError, ChaosInjectedError),
+        giveup_on=(FileNotFoundError, IsADirectoryError, NotADirectoryError),
+    )
+
+
+def _read_hf_checkpoint(model_path: str):
+    """(AutoConfig, torch state dict) for a local HF dir, under the retry
+    policy above, with the chaos ``hf-load`` fault site inside the retried
+    body so injected faults exercise the same recovery path as real ones."""
+    from trlx_tpu.resilience.chaos import chaos
+    from trlx_tpu.resilience.retry import retry_call
+
+    def read():
+        chaos.fail_if_armed("hf-load", detail=model_path)
+        import transformers
+
+        hf_config = transformers.AutoConfig.from_pretrained(model_path)
+        return hf_config, load_torch_state_dict(model_path)
+
+    return retry_call(read, policy=_hf_load_retry_policy(), name=f"hf-load {model_path}")
+
+
 def load_pretrained(
     model_path: str,
     overrides: Optional[Dict[str, Any]] = None,
@@ -544,11 +578,8 @@ def load_pretrained(
         )
     config_path = os.path.join(model_path, "config.json")
     if os.path.isdir(model_path) and os.path.exists(config_path):
-        import transformers
-
-        hf_config = transformers.AutoConfig.from_pretrained(model_path)
+        hf_config, sd = _read_hf_checkpoint(model_path)
         config = from_hf_config(hf_config, overrides)
-        sd = load_torch_state_dict(model_path)
         params = hf_state_dict_to_params(hf_config.model_type, sd, config)
         return config, params, hf_config.model_type
     config = get_preset(model_path, overrides)
@@ -774,11 +805,8 @@ def load_pretrained_seq2seq(
         return config, params
     config_path = os.path.join(model_path, "config.json")
     if os.path.isdir(model_path) and os.path.exists(config_path):
-        import transformers
-
-        hf_config = transformers.AutoConfig.from_pretrained(model_path)
+        hf_config, sd = _read_hf_checkpoint(model_path)
         config = from_hf_t5_config(hf_config, overrides)
-        sd = load_torch_state_dict(model_path)
         return config, t5_state_dict_to_params(sd, config)
     config = T5Config()
     if overrides:

@@ -128,6 +128,9 @@ class Observability:
         if interval and self._step_count % interval == 0:
             stats.update(device_memory_stats())
         stats.update(gauges.snapshot("obs/"))
+        # resilience gauges (retry counts, inflight checkpoint writes, commit
+        # latency) ride the same per-step export to every tracker backend
+        stats.update(gauges.snapshot("resilience/"))
         return stats
 
     # -------------------------------------------------------------- lifecycle

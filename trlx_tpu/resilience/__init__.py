@@ -1,0 +1,68 @@
+"""Resilience subsystem: survive preemptions and transient faults
+(docs/resilience.md).
+
+Five primitives, each usable standalone, plus the :class:`Resilience` facade
+the trainer drives from ``TRLConfig.train.resilience``:
+
+- :mod:`trlx_tpu.resilience.checkpoint` — atomic commit protocol
+  (tmp-dir → rename → ``_COMMITTED`` sentinel), retention GC, and the
+  background :class:`AsyncCheckpointWriter` that takes checkpointing off the
+  learner's critical path.
+- :mod:`trlx_tpu.resilience.preemption` — SIGTERM/SIGINT grace-window
+  handler: flag now, emergency-checkpoint at the next step boundary.
+- :mod:`trlx_tpu.resilience.resume` — newest-committed-checkpoint discovery
+  (numeric step order, torn dirs skipped) and RNG state packing.
+- :mod:`trlx_tpu.resilience.retry` — backoff + jitter + deadline for flaky
+  host-side calls (reward RPCs, HF hub loads).
+- :mod:`trlx_tpu.resilience.chaos` — ``TRLX_CHAOS`` fault injection that
+  proves all of the above in tests.
+"""
+
+from trlx_tpu.resilience.chaos import ChaosInjectedError, ChaosMonkey, chaos
+from trlx_tpu.resilience.checkpoint import (
+    COMMITTED_SENTINEL,
+    AsyncCheckpointWriter,
+    gc_checkpoints,
+    is_committed,
+    mark_committed,
+    write_checkpoint,
+    write_json_atomic,
+)
+from trlx_tpu.resilience.preemption import PreemptionHandler
+from trlx_tpu.resilience.resume import (
+    CHECKPOINT_PREFIX,
+    checkpoint_step,
+    find_latest_committed,
+    list_checkpoints,
+)
+from trlx_tpu.resilience.retry import (
+    RetryDeadlineExceeded,
+    RetryPolicy,
+    retry_call,
+    with_retries,
+)
+from trlx_tpu.resilience.runtime import PROTECTED_CHECKPOINTS, Resilience
+
+__all__ = [
+    "AsyncCheckpointWriter",
+    "CHECKPOINT_PREFIX",
+    "COMMITTED_SENTINEL",
+    "ChaosInjectedError",
+    "ChaosMonkey",
+    "PROTECTED_CHECKPOINTS",
+    "PreemptionHandler",
+    "Resilience",
+    "RetryDeadlineExceeded",
+    "RetryPolicy",
+    "chaos",
+    "checkpoint_step",
+    "find_latest_committed",
+    "gc_checkpoints",
+    "is_committed",
+    "list_checkpoints",
+    "mark_committed",
+    "retry_call",
+    "with_retries",
+    "write_checkpoint",
+    "write_json_atomic",
+]

@@ -1,0 +1,136 @@
+"""Env-driven fault injection for resilience testing.
+
+A resilience subsystem that is only exercised by real outages is untested
+code. :class:`ChaosMonkey` lets tests (and brave operators) inject the exact
+faults the subsystem claims to survive — without touching application code
+paths beyond a one-line ``chaos.fail_if_armed("site")`` at each seam.
+
+Faults are armed through the ``TRLX_CHAOS`` environment variable (or
+programmatically via :meth:`ChaosMonkey.configure`), as a comma-separated list
+of ``site:arg`` tokens:
+
+- ``reward:N`` — the next ``N`` reward-fn calls raise
+  :class:`ChaosInjectedError` (exercises the retry wrapper);
+- ``rollout-producer:N`` — the async rollout producer thread dies ``N`` times
+  (exercises queue close-on-death and error propagation);
+- ``hf-load:N`` — the next ``N`` HF checkpoint loads fail (exercises the
+  hub-loading retry policy);
+- ``checkpoint:N`` — the next ``N`` checkpoint payload writes fail *before*
+  the commit rename (exercises torn-checkpoint detection: the ``.tmp`` dir is
+  left behind, no ``_COMMITTED`` sentinel ever appears);
+- ``preempt-step:N`` — a simulated preemption "signal" is reported once the
+  trainer reaches optimizer step ``N`` (exercises the emergency-checkpoint +
+  auto-resume path end-to-end, no real SIGTERM required).
+
+Count-based sites are *budgets*: each injected fault decrements the budget, so
+``reward:2`` means exactly two failures then clean behavior — which is exactly
+the shape of a transient outage.
+
+The process-global handle is ``trlx_tpu.resilience.chaos.chaos``. It reads the
+env var at each :meth:`reload_from_env`; the ``Resilience`` runtime calls that
+at trainer init, so subprocess-spawned trainers pick up the spec without any
+plumbing. With no spec armed, every check is a dict lookup that misses —
+effectively free.
+"""
+
+import os
+import threading
+from typing import Dict, Optional
+
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+ENV_VAR = "TRLX_CHAOS"
+
+# count-budget sites; "preempt-step" is threshold-based and handled separately
+_COUNT_SITES = ("reward", "rollout-producer", "hf-load", "checkpoint")
+
+
+class ChaosInjectedError(RuntimeError):
+    """A fault deliberately injected by :class:`ChaosMonkey`."""
+
+
+class ChaosMonkey:
+    def __init__(self, spec: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._budgets: Dict[str, int] = {}
+        self._preempt_step: Optional[int] = None
+        self._preempt_fired = False
+        self._injected: Dict[str, int] = {}
+        if spec:
+            self.configure(spec)
+
+    def configure(self, spec: Optional[str]) -> None:
+        """Arm faults from a spec string (see module docstring); ``None``/"" disarms."""
+        with self._lock:
+            self._budgets = {}
+            self._preempt_step = None
+            self._preempt_fired = False
+            self._injected = {}
+            if not spec:
+                return
+            for token in spec.split(","):
+                token = token.strip()
+                if not token:
+                    continue
+                site, _, arg = token.partition(":")
+                site = site.strip()
+                try:
+                    count = int(arg.strip()) if arg.strip() else 1
+                except ValueError:
+                    raise ValueError(f"chaos spec token {token!r}: argument must be an integer")
+                if site == "preempt-step":
+                    self._preempt_step = count
+                elif site in _COUNT_SITES:
+                    self._budgets[site] = self._budgets.get(site, 0) + count
+                else:
+                    raise ValueError(
+                        f"chaos spec token {token!r}: unknown site "
+                        f"(expected one of {_COUNT_SITES + ('preempt-step',)})"
+                    )
+            logger.warning(f"chaos armed: budgets={self._budgets} preempt_step={self._preempt_step}")
+
+    def reload_from_env(self) -> None:
+        self.configure(os.environ.get(ENV_VAR))
+
+    @property
+    def armed(self) -> bool:
+        with self._lock:
+            return bool(self._budgets) or self._preempt_step is not None
+
+    def should_fail(self, site: str) -> bool:
+        """Consume one unit of ``site``'s fault budget; True if a fault fires."""
+        with self._lock:
+            remaining = self._budgets.get(site, 0)
+            if remaining <= 0:
+                return False
+            self._budgets[site] = remaining - 1
+            self._injected[site] = self._injected.get(site, 0) + 1
+            return True
+
+    def fail_if_armed(self, site: str, detail: str = "") -> None:
+        """Raise :class:`ChaosInjectedError` if ``site`` has budget left."""
+        if self.should_fail(site):
+            suffix = f" ({detail})" if detail else ""
+            raise ChaosInjectedError(f"chaos: injected failure at site {site!r}{suffix}")
+
+    def preempt_due(self, step: int) -> bool:
+        """True exactly once, when ``step`` first reaches the armed threshold."""
+        with self._lock:
+            if self._preempt_step is None or self._preempt_fired:
+                return False
+            if step >= self._preempt_step:
+                self._preempt_fired = True
+                self._injected["preempt-step"] = 1
+                return True
+            return False
+
+    def stats(self) -> Dict[str, int]:
+        """Faults injected so far, by site (for tests and logs)."""
+        with self._lock:
+            return dict(self._injected)
+
+
+# Process-global handle; tests reset it via chaos.configure(None).
+chaos = ChaosMonkey()

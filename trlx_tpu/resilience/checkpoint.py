@@ -1,0 +1,232 @@
+"""Atomic, asynchronous checkpointing.
+
+**The atomicity protocol.** A checkpoint directory is *committed* iff it
+contains the ``_COMMITTED`` sentinel file. Writers build the full payload in a
+sibling ``<name>.tmp`` directory, ``os.replace`` it to its final name, then
+write the sentinel and fsync the parent directory. A crash or preemption at
+any point therefore leaves one of exactly three states — nothing, an orphaned
+``.tmp`` dir, or a final-named dir without the sentinel — all of which
+:func:`trlx_tpu.resilience.resume.find_latest_committed` recognizes as torn
+and skips. A sentinel is never present over partial bytes.
+
+**The async writer.** ``orbax``'s ``save()`` dispatches device→host transfers
+asynchronously, but the existing trainer immediately calls
+``wait_until_finished()``, stalling the learn loop for the full serialize+
+write. :class:`AsyncCheckpointWriter` instead snapshots the (already
+host-side) trees handed to it and runs serialize→fsync→rename→sentinel on a
+background thread; the learner only blocks when a *prior* write is still in
+flight (one write in flight at a time keeps peak host memory to one snapshot
+and makes commit order equal request order). The writer thread beats the
+stall watchdog while committing so a long write is distinguishable from a
+hang, and errors are re-raised on the learner thread at the next
+``save()``/``wait()`` — a failing disk must not be silent.
+
+Single-process only: on multi-host, orbax saves are collective operations
+that every process must enter, which a per-host background thread cannot
+order safely. The ``Resilience`` runtime falls back to the synchronous path
+there.
+"""
+
+import json
+import os
+import shutil
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from trlx_tpu.obs import span, watchdog
+from trlx_tpu.resilience.chaos import chaos
+from trlx_tpu.utils import logging
+from trlx_tpu.utils.metrics import gauges
+
+logger = logging.get_logger(__name__)
+
+COMMITTED_SENTINEL = "_COMMITTED"
+TMP_SUFFIX = ".tmp"
+STATE_FILE = "state.json"
+WRITER_HEARTBEAT = "checkpoint-writer"
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so renames/creates inside it survive power loss;
+    best-effort on filesystems that reject directory fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def is_committed(path: str) -> bool:
+    """True iff ``path`` is a checkpoint directory with the commit sentinel."""
+    return os.path.isdir(path) and os.path.exists(os.path.join(path, COMMITTED_SENTINEL))
+
+
+def mark_committed(path: str) -> None:
+    """Write the commit sentinel (the LAST step of any checkpoint write)."""
+    sentinel = os.path.join(path, COMMITTED_SENTINEL)
+    with open(sentinel, "w") as f:
+        f.write(f"committed {time.time():.3f}\n")
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_dir(path)
+
+
+def write_json_atomic(path: str, obj: Any) -> None:
+    """Write JSON via tmp-file + fsync + rename: readers see old or new, never torn."""
+    tmp = path + TMP_SUFFIX
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
+def write_checkpoint(path: str, trees: Dict[str, Any], state: Dict[str, Any]) -> str:
+    """Commit ``trees`` (name -> host pytree, saved via orbax) and ``state``
+    (JSON) to ``path`` under the atomicity protocol in the module docstring.
+    Runs on the caller's thread; the async writer calls this from its worker."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    tmp = path + TMP_SUFFIX
+    if os.path.exists(tmp):  # leftover from a previous crash mid-write
+        shutil.rmtree(tmp)
+    os.makedirs(tmp, exist_ok=True)
+    try:
+        chaos.fail_if_armed("checkpoint", detail=path)
+        ckptr = ocp.StandardCheckpointer()
+        for name, tree in trees.items():
+            ckptr.save(os.path.join(tmp, name), tree, force=True)
+        ckptr.wait_until_finished()
+        write_json_atomic(os.path.join(tmp, STATE_FILE), state)
+    except BaseException:
+        # the sentinel was never written and the final name never created:
+        # a failed write leaves no dir a resume scan could mistake for real
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    if os.path.exists(path):  # re-saving the same step (e.g. best_checkpoint)
+        shutil.rmtree(path)
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
+    mark_committed(path)
+    return path
+
+
+def gc_checkpoints(
+    checkpoint_dir: str,
+    keep_last: int,
+    protected: Optional[List[str]] = None,
+    prefix: str = "checkpoint_",
+) -> List[str]:
+    """Delete all but the newest ``keep_last`` step checkpoints under
+    ``checkpoint_dir``. Only committed, ``prefix``-named dirs are candidates:
+    ``.tmp`` leftovers, uncommitted dirs, and ``protected`` names
+    (``best_checkpoint``, ``hf_model``) are never touched — an uncommitted dir
+    may be a write in flight. Returns the deleted paths."""
+    from trlx_tpu.resilience.resume import checkpoint_step
+
+    protected = set(protected or [])
+    if keep_last <= 0 or not os.path.isdir(checkpoint_dir):
+        return []
+    candidates = []
+    for name in os.listdir(checkpoint_dir):
+        if not name.startswith(prefix) or name.endswith(TMP_SUFFIX) or name in protected:
+            continue
+        path = os.path.join(checkpoint_dir, name)
+        step = checkpoint_step(name, prefix)
+        if step is None or not is_committed(path):
+            continue
+        candidates.append((step, path))
+    candidates.sort()
+    deleted = []
+    for _, path in candidates[:-keep_last]:
+        shutil.rmtree(path, ignore_errors=True)
+        deleted.append(path)
+        logger.info(f"Retention: deleted old checkpoint {path}")
+    return deleted
+
+
+class AsyncCheckpointWriter:
+    """One-in-flight background checkpoint committer (see module docstring)."""
+
+    def __init__(self, keep_last: int = 0, protected: Optional[List[str]] = None):
+        self.keep_last = keep_last
+        self.protected = list(protected or [])
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._last_committed: Optional[str] = None
+
+    @property
+    def in_flight(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    @property
+    def last_committed(self) -> Optional[str]:
+        return self._last_committed
+
+    def save(
+        self,
+        path: str,
+        trees: Dict[str, Any],
+        state: Dict[str, Any],
+        block: bool = False,
+    ) -> None:
+        """Queue one commit. Blocks only while a *prior* write is in flight
+        (or entirely, with ``block=True`` — the emergency-checkpoint path).
+        ``trees`` must already be host-side (``jax.device_get``) so the commit
+        never touches live device buffers the train step may donate."""
+        self.wait()  # also re-raises a previous write's error on this thread
+
+        def commit():
+            t0 = time.monotonic()
+            try:
+                watchdog.beat(WRITER_HEARTBEAT)
+                with span("checkpoint_commit"):
+                    write_checkpoint(path, trees, state)
+                if self.keep_last:
+                    gc_checkpoints(os.path.dirname(path), self.keep_last, self.protected)
+                self._last_committed = os.path.abspath(path)
+                gauges.inc("resilience/ckpt_committed")
+                gauges.set("resilience/ckpt_commit_s", time.monotonic() - t0)
+                logger.info(
+                    f"Committed checkpoint {path} in {time.monotonic() - t0:.2f}s"
+                )
+            except BaseException as e:
+                self._error = e
+                logger.error(f"Checkpoint commit to {path} FAILED: {e}")
+            finally:
+                gauges.set("resilience/ckpt_inflight", 0.0)
+                # no false posthumous stall report from an idle writer
+                watchdog.unregister(WRITER_HEARTBEAT)
+
+        gauges.set("resilience/ckpt_inflight", 1.0)
+        self._thread = threading.Thread(target=commit, name="ckpt-writer", daemon=True)
+        self._thread.start()
+        if block:
+            self.wait()
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Join the in-flight write (if any); re-raise its error here."""
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout)
+            if thread.is_alive():
+                raise TimeoutError(f"checkpoint write still in flight after {timeout}s")
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("async checkpoint write failed") from err
+
+    def close(self) -> None:
+        """Flush the in-flight write; errors are logged, not raised (teardown)."""
+        try:
+            self.wait()
+        except Exception as e:
+            logger.error(f"async checkpoint writer: error during close: {e}")

@@ -1,0 +1,119 @@
+"""Auto-resume: find the newest *committed* checkpoint, restore full state.
+
+A preempted-and-restarted job must continue with zero operator flags. The
+contract has two halves:
+
+1. **Selection** (:func:`find_latest_committed`): scan ``checkpoint_dir`` for
+   ``checkpoint_<step>`` directories, order by **numeric** step (robust to
+   legacy unpadded names, where lexicographic order put ``checkpoint_9`` after
+   ``checkpoint_10``), and return the newest one carrying the ``_COMMITTED``
+   sentinel. Torn directories — a rename that landed but whose sentinel write
+   didn't, or an interrupted legacy synchronous save — are skipped with a
+   warning, falling back to the next-newest committed one. ``best_checkpoint``
+   is deliberately *not* a resume candidate: it is reward-ordered, not
+   time-ordered.
+
+2. **State** (the trainer's ``_state_dict``/``load``): beyond params and
+   opt_state, a faithful resume restores ``iter_count``, ``best_reward``
+   (else the first post-resume eval re-saves a worse "best"), the eval
+   counter, both RNG streams (the jax sampling key and the host numpy
+   generator), and the dataloader position (PPO's prompt-stream draw count —
+   replayed exactly, because ``NumpyLoader`` reshuffles per epoch so position
+   N is only reproducible by drawing N times from the same seed).
+
+RNG packing: jax 0.4.x `PRNGKey`s are uint32[2] arrays; typed keys
+(`jax.random.key`) are unwrapped via ``key_data``. Numpy state is the
+``bit_generator.state`` dict, which is JSON-clean for PCG64.
+"""
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+from trlx_tpu.resilience.checkpoint import COMMITTED_SENTINEL, TMP_SUFFIX, is_committed
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+CHECKPOINT_PREFIX = "checkpoint_"
+
+
+def checkpoint_step(name: str, prefix: str = CHECKPOINT_PREFIX) -> Optional[int]:
+    """Numeric step from a ``checkpoint_<step>`` dir name; None if not one."""
+    if not name.startswith(prefix) or name.endswith(TMP_SUFFIX):
+        return None
+    suffix = name[len(prefix):]
+    if not suffix.isdigit():
+        return None
+    return int(suffix)
+
+
+def list_checkpoints(checkpoint_dir: str) -> List[Tuple[int, str]]:
+    """All step-checkpoint dirs under ``checkpoint_dir`` as (step, path),
+    sorted by step ascending — committed or not."""
+    if not os.path.isdir(checkpoint_dir):
+        return []
+    out = []
+    for name in sorted(os.listdir(checkpoint_dir)):
+        step = checkpoint_step(name)
+        path = os.path.join(checkpoint_dir, name)
+        if step is not None and os.path.isdir(path):
+            out.append((step, path))
+    out.sort(key=lambda sp: sp[0])
+    return out
+
+
+def find_latest_committed(checkpoint_dir: str) -> Optional[str]:
+    """Newest committed step checkpoint, skipping torn dirs (see module doc)."""
+    for step, path in reversed(list_checkpoints(checkpoint_dir)):
+        if is_committed(path):
+            return path
+        logger.warning(
+            f"Auto-resume: skipping {path} — no {COMMITTED_SENTINEL} sentinel "
+            "(torn or in-flight write)"
+        )
+    return None
+
+
+# ------------------------------------------------------------------ RNG state
+
+
+def pack_rng_key(key) -> List[int]:
+    """jax PRNG key -> JSON-clean list of uint32 words."""
+    data = jax.random.key_data(key) if jnp_is_typed_key(key) else key
+    return [int(x) for x in np.asarray(jax.device_get(data)).ravel()]
+
+
+def unpack_rng_key(words: List[int], like) -> Any:
+    """Inverse of :func:`pack_rng_key`, shaped/typed like the current key."""
+    if jnp_is_typed_key(like):
+        impl = jax.random.key_impl(like)
+        return jax.random.wrap_key_data(
+            np.asarray(words, np.uint32).reshape(jax.random.key_data(like).shape),
+            impl=impl,
+        )
+    arr = np.asarray(words, dtype=np.asarray(jax.device_get(like)).dtype)
+    return arr.reshape(np.asarray(jax.device_get(like)).shape)
+
+
+def jnp_is_typed_key(key) -> bool:
+    """True for new-style typed PRNG keys (jax.random.key), False for the
+    legacy uint32[2] arrays this codebase uses (jax.random.PRNGKey)."""
+    try:
+        import jax.dtypes
+
+        return jax.dtypes.issubdtype(key.dtype, jax.dtypes.prng_key)
+    except (AttributeError, TypeError):
+        return False
+
+
+def pack_np_rng(np_rng: np.random.Generator) -> Dict[str, Any]:
+    """numpy Generator -> its JSON-serializable bit_generator state dict."""
+    return np_rng.bit_generator.state
+
+
+def restore_np_rng(np_rng: np.random.Generator, state: Dict[str, Any]) -> None:
+    np_rng.bit_generator.state = state

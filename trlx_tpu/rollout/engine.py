@@ -33,6 +33,7 @@ import time
 from typing import Any, Callable, List, Optional
 
 from trlx_tpu.obs import span, watchdog
+from trlx_tpu.resilience.chaos import chaos
 from trlx_tpu.rollout.publisher import ParameterPublisher
 from trlx_tpu.rollout.queue import ExperienceQueue, QueueClosed
 from trlx_tpu.rollout.staleness import StalenessAccountant
@@ -89,6 +90,9 @@ class AsyncRolloutEngine:
                 with self._pause_lock:
                     if self._stop_evt.is_set():
                         break
+                    # resilience fault site: lets tests kill the producer and
+                    # prove the close-on-death / re-raise-from-collect contract
+                    chaos.fail_if_armed("rollout-producer")
                     version, params = self.publisher.latest()
                     t0 = time.monotonic()
                     elements = self._produce(params, version)

@@ -30,6 +30,7 @@ from trlx_tpu.ops.generation import generate as generate_op
 from trlx_tpu.ops.generation import generate_seq2seq, left_pad_batch, pad_to_bucket
 from trlx_tpu.parallel import mesh as mesh_lib
 from trlx_tpu.pipeline.tokenization import load_tokenizer
+from trlx_tpu.resilience import Resilience, find_latest_committed
 from trlx_tpu.trainer import BaseRLTrainer, register_trainer
 from trlx_tpu.utils import (
     Clock,
@@ -126,6 +127,14 @@ class MeshRLTrainer(BaseRLTrainer):
             config.train.checkpoint_dir, "logs"
         )
         self.obs = Observability(config.train.observability, obs_logging_dir)
+        # resilience subsystem (async atomic checkpointing / preemption
+        # handling / auto-resume / reward retries); with the default disabled
+        # config every hook is a no-op and reward_fn is wrapped only with the
+        # free chaos check
+        self.resilience = Resilience(
+            config.train.resilience, multiprocess=jax.process_count() > 1
+        )
+        self.reward_fn = self.resilience.wrap_reward_fn(self.reward_fn)
 
     # ------------------------------------------------------------- model setup
 
@@ -626,22 +635,48 @@ class MeshRLTrainer(BaseRLTrainer):
             return self._learn_loop()
         finally:
             self.on_learn_end()
+            # after the engine drain: the writer flush below may be the
+            # emergency checkpoint, and the producer must not race it
+            self.resilience.close()
             # after on_learn_end: producer teardown spans still get recorded
             self.obs.close()
 
+    def _maybe_resume(self, train_config):
+        """Restore from an explicit resume path (missing → hard error, never a
+        silent fresh start) or, under resilience auto-resume, from the newest
+        *committed* checkpoint in checkpoint_dir. Runs BEFORE prepare_learning
+        so the first rollouts already use the restored params, RNG streams,
+        and prompt-stream position."""
+        path = train_config.resume_from_checkpoint
+        if path:
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"train.resume_from_checkpoint={path!r} does not exist; "
+                    "refusing to silently train from scratch"
+                )
+            self.load(path)
+            return
+        if self.resilience.auto_resume:
+            latest = find_latest_committed(train_config.checkpoint_dir)
+            if latest is not None:
+                logger.info(f"Auto-resume: restoring newest committed checkpoint {latest}")
+                self.load(latest)
+
     def _learn_loop(self):
         train_config = self.config.train
-        self.prepare_learning()
         self.iter_count = 0
+        self._maybe_resume(train_config)
+        self.prepare_learning()
         self.obs.configure_model(self.params, getattr(self, "model_config", None))
         self.obs.beat("learner")
-
-        if train_config.resume_from_checkpoint and os.path.exists(train_config.resume_from_checkpoint):
-            self.load(train_config.resume_from_checkpoint)
 
         with self.obs.span("evaluate"):
             results = self.evaluate() if getattr(self, "eval_pipeline", None) else {}
         self.tracker.log(results, self.iter_count)
+        if self.iter_count >= train_config.total_steps:
+            # resumed at (or past) the end of training: nothing left to do
+            self._report_sweep_result(results)
+            return results
 
         profiling = False
         try:
@@ -665,13 +700,17 @@ class MeshRLTrainer(BaseRLTrainer):
                     self.obs.beat("learner")
                     self.post_backward_callback()
 
+                    if self.resilience.should_stop(self.iter_count):
+                        return self._preempt_exit(stats)
+
                     if (
                         train_config.checkpoint_interval
                         and self.iter_count % train_config.checkpoint_interval == 0
                     ):
-                        subfolder = f"checkpoint_{self.iter_count:0{len(str(train_config.total_steps))}d}"
                         with self.obs.span("checkpoint"):
-                            self.save(os.path.join(train_config.checkpoint_dir, subfolder))
+                            self._save_checkpoint(
+                                os.path.join(train_config.checkpoint_dir, self._checkpoint_name())
+                            )
                             self.save_pretrained(os.path.join(train_config.checkpoint_dir, "hf_model"))
 
                     if (
@@ -687,7 +726,9 @@ class MeshRLTrainer(BaseRLTrainer):
                             # replacing the reference's MAX all-reduce guard (:616-638)
                             if results["reward/mean"] > self.best_reward:
                                 self.best_reward = results["reward/mean"]
-                                self.save(os.path.join(train_config.checkpoint_dir, "best_checkpoint"))
+                                self._save_checkpoint(
+                                    os.path.join(train_config.checkpoint_dir, "best_checkpoint")
+                                )
                         if self._sweep_tick(results):
                             # ASHA early stop: exit cleanly (no signals — killing a
                             # jax process mid-TPU-claim can wedge the chip tunnel)
@@ -705,7 +746,11 @@ class MeshRLTrainer(BaseRLTrainer):
                         logger.info(f"step {self.iter_count}/{train_config.total_steps} {brief}")
 
                     if self.iter_count >= train_config.total_steps:
-                        self.save(os.path.join(train_config.checkpoint_dir, f"checkpoint_{self.iter_count}"))
+                        # padded like the interval checkpoints, so the dir's
+                        # lexicographic order is chronological (resume relies on it)
+                        self._save_checkpoint(
+                            os.path.join(train_config.checkpoint_dir, self._checkpoint_name())
+                        )
                         self._report_sweep_result(results)
                         return results
                 self.post_epoch_callback(epoch)
@@ -749,10 +794,85 @@ class MeshRLTrainer(BaseRLTrainer):
 
     # ------------------------------------------------------------- checkpoints
 
+    def _checkpoint_name(self, it: Optional[int] = None) -> str:
+        """``checkpoint_<step>`` zero-padded to total_steps' width, so the
+        checkpoint dir's lexicographic order equals chronological order (the
+        resume scan additionally parses legacy unpadded names numerically)."""
+        it = self.iter_count if it is None else it
+        return f"checkpoint_{it:0{len(str(self.config.train.total_steps))}d}"
+
+    def _state_dict(self) -> Dict[str, Any]:
+        """Full JSON-serializable trainer state for ``state.json``: counters,
+        both RNG streams (jax sampling key + host numpy generator), and
+        algorithm extras (PPO's prompt-stream position) — everything needed to
+        continue the exact sample sequence after a restart."""
+        from trlx_tpu.resilience.resume import pack_np_rng, pack_rng_key
+
+        return {
+            "iter_count": self.iter_count,
+            "best_reward": self.best_reward,
+            "nth_evaluation": self.nth_evaluation,
+            "rng_key": pack_rng_key(self.rng),
+            "np_rng_state": pack_np_rng(self.np_rng),
+            **self._extra_state(),
+        }
+
+    def _extra_state(self) -> Dict[str, Any]:
+        """Algorithm-specific additions to state.json (override in subclasses)."""
+        return {}
+
+    def _restore_extra_state(self, state: Dict[str, Any]):
+        """Inverse of :meth:`_extra_state` (state.json dict, already loaded)."""
+        pass
+
+    def _save_checkpoint(self, directory: str, block: bool = False):
+        """Route one checkpoint through the resilience async writer when
+        available (host snapshot now, serialize + atomic commit on the writer
+        thread; only waits if a *prior* write is still in flight) or the
+        synchronous :meth:`save` otherwise. ``block=True`` is the emergency-
+        checkpoint path: the commit must land inside the grace window."""
+        writer = self.resilience.writer
+        if writer is None:
+            self.save(directory)
+            return
+        # host snapshot before returning to the loop: the next train step
+        # donates the device buffers, so the writer must never touch them
+        trees = {"params": jax.device_get(self.params)}
+        if self.config.train.save_optimizer:
+            trees["opt_state"] = jax.device_get(self.opt_state)
+        writer.save(os.path.abspath(directory), trees, self._state_dict(), block=block)
+
+    def _preempt_exit(self, results):
+        """Preemption path: blocking emergency checkpoint inside the grace
+        window, then a clean return (``learn()``'s finally drains the rollout
+        engine, flushes the writer, and closes the trackers)."""
+        handler = self.resilience.preemption
+        grace = handler.grace_remaining_s
+        logger.warning(
+            f"Preempted ({handler.reason}); writing emergency checkpoint at "
+            f"step {self.iter_count} ({grace:.0f}s of grace remaining)"
+        )
+        path = os.path.join(self.config.train.checkpoint_dir, self._checkpoint_name())
+        with self.obs.span("checkpoint"):
+            self._save_checkpoint(path, block=True)
+        remaining = handler.grace_remaining_s
+        if remaining is not None and remaining < 0:
+            logger.warning(
+                f"Emergency checkpoint exceeded the grace window by {-remaining:.0f}s "
+                "— raise resilience.grace_period_s or shrink checkpoint_interval"
+            )
+        self._report_sweep_result(results)
+        return results
+
     def save(self, directory: str):
-        """Sharded checkpoint (params, opt_state, iter_count) via orbax (parity:
-        accelerator.save_state, accelerate_base_trainer.py:309-317)."""
+        """Sharded checkpoint (params, opt_state, state.json) via orbax (parity:
+        accelerator.save_state, accelerate_base_trainer.py:309-317). state.json
+        is written atomically (tmp file + rename) and the ``_COMMITTED``
+        sentinel lands last, marking the directory complete — :meth:`load`
+        warns when it is missing and auto-resume skips such torn dirs."""
         import orbax.checkpoint as ocp
+
+        from trlx_tpu.resilience.checkpoint import STATE_FILE, mark_committed, write_json_atomic
 
         path = os.path.abspath(directory)
         ckptr = ocp.StandardCheckpointer()
@@ -761,8 +881,8 @@ class MeshRLTrainer(BaseRLTrainer):
             ckptr.save(os.path.join(path, "opt_state"), self.opt_state, force=True)
         ckptr.wait_until_finished()
         if jax.process_index() == 0:
-            with open(os.path.join(path, "state.json"), "w") as f:
-                json.dump({"iter_count": self.iter_count, "best_reward": self.best_reward}, f)
+            write_json_atomic(os.path.join(path, STATE_FILE), self._state_dict())
+            mark_committed(path)
         logger.info(f"Saved checkpoint to {path}")
 
     def load(self, directory: str):
@@ -770,7 +890,15 @@ class MeshRLTrainer(BaseRLTrainer):
         accelerate_base_trainer.py:318-333)."""
         import orbax.checkpoint as ocp
 
+        from trlx_tpu.resilience.checkpoint import is_committed
+
         path = os.path.abspath(directory)
+        if not is_committed(path):
+            logger.warning(
+                f"Checkpoint {path} has no _COMMITTED sentinel — it may be torn "
+                "(interrupted write) or predate atomic saves; restoring anyway "
+                "since it was requested explicitly"
+            )
         ckptr = ocp.StandardCheckpointer()
 
         def restore_like(sub, template):
@@ -795,10 +923,18 @@ class MeshRLTrainer(BaseRLTrainer):
             self.opt_state = restore_like(opt_path, self.opt_state)
         state_path = os.path.join(path, "state.json")
         if os.path.exists(state_path):
+            from trlx_tpu.resilience.resume import restore_np_rng, unpack_rng_key
+
             with open(state_path) as f:
                 state = json.load(f)
             self.iter_count = state.get("iter_count", 0)
             self.best_reward = state.get("best_reward", -float("inf"))
+            self.nth_evaluation = state.get("nth_evaluation", self.nth_evaluation)
+            if state.get("rng_key") is not None:
+                self.rng = unpack_rng_key(state["rng_key"], self.rng)
+            if state.get("np_rng_state") is not None:
+                restore_np_rng(self.np_rng, state["np_rng_state"])
+            self._restore_extra_state(state)
         logger.info(f"Restored checkpoint from {path} (iter {self.iter_count})")
 
     def save_pretrained(self, directory: str):

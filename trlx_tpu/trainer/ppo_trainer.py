@@ -61,6 +61,12 @@ class PPOTrainer(MeshRLTrainer):
         self._async_cfg = None
         self._policy_version = 0
 
+        # prompt-stream position (trlx_tpu/resilience): draws from the
+        # infinite prompt iterator, checkpointed and replayed on resume so a
+        # restarted run continues the exact prompt sequence
+        self._prompt_batches_drawn = 0
+        self._resume_prompt_batches = 0
+
         if config.train.rollout_logging_dir is not None:
             self.log_rollouts = True
             self.setup_rollout_logging(config)
@@ -456,6 +462,7 @@ class PPOTrainer(MeshRLTrainer):
         sub-chunks for reward_fn / the scoring forward. ``params`` overrides
         the sampling params (async producer passes a published snapshot)."""
         batch = next(self.prompt_iterator)
+        self._prompt_batches_drawn += 1
         prompts = batch["input_ids"]
         metadata = {k: v for k, v in batch.items() if k != "input_ids"}
         samples, resp_mask, pad_len = self.generate(prompts, eval_mode=False, params=params)
@@ -769,9 +776,30 @@ class PPOTrainer(MeshRLTrainer):
 
     # ------------------------------------------------------------- train loop
 
+    def _extra_state(self):
+        return {"prompt_batches_drawn": self._prompt_batches_drawn}
+
+    def _restore_extra_state(self, state):
+        self._resume_prompt_batches = int(state.get("prompt_batches_drawn", 0))
+
+    def _fast_forward_prompt_stream(self):
+        """Replay the restored number of prompt-batch draws. Exact replay (not
+        modulo the loader length) because ``NumpyLoader`` reshuffles per epoch
+        from ``seed + epoch`` — position N is only reproducible by drawing N
+        times from the same freshly-built iterator."""
+        n = self._resume_prompt_batches
+        self._resume_prompt_batches = 0
+        if n <= 0 or getattr(self, "prompt_iterator", None) is None:
+            return
+        for _ in range(n):
+            next(self.prompt_iterator)
+        self._prompt_batches_drawn = n
+        logger.info(f"Auto-resume: fast-forwarded the prompt stream by {n} batches")
+
     def prepare_learning(self):
         bs = self.config.train.batch_size
         self.num_mb = max(1, bs // (self.config.train.minibatch_size or bs))
+        self._fast_forward_prompt_stream()
         self._async_cfg = self._resolve_async_config()
         if self._async_cfg is not None:
             self._start_async_engine()
