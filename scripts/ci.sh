@@ -11,6 +11,12 @@ python -m compileall -q trlx_tpu examples tests scripts bench.py __graft_entry__
 echo "== lint (scripts/lint.py)"
 python scripts/lint.py trlx_tpu examples tests scripts bench.py __graft_entry__.py
 
+echo "== graftcheck (python -m trlx_tpu.analysis)"
+# semantic gate: JAX RNG/tracing discipline + thread/lock discipline (docs/
+# static-analysis.md). Hard-fails on any finding that is neither noqa'd at
+# the line nor justified in graftcheck-baseline.txt
+JAX_PLATFORMS=cpu python -m trlx_tpu.analysis trlx_tpu tests examples scripts bench.py __graft_entry__.py
+
 echo "== tests"
 if [[ "${1:-}" == "--slow" ]]; then
     # full suite; records the round's TESTS artifact (pass/fail counts,
@@ -37,6 +43,12 @@ echo "== observability tests (CPU)"
 # a watchdog or tracer deadlock must fail fast, not hang CI
 JAX_PLATFORMS=cpu timeout -k 10 300 \
     python -m pytest tests/test_obs.py tests/test_trackers.py -q -m "not slow" -p no:cacheprovider
+
+echo "== analysis tests (CPU)"
+# graftcheck's own suite: rule positives/negatives, noqa, baseline, CLI;
+# bounded like the others so a runaway fixture scan fails fast
+JAX_PLATFORMS=cpu timeout -k 10 300 \
+    python -m pytest tests/test_analysis.py -q -m "not slow" -p no:cacheprovider
 
 echo "== resilience tests (CPU)"
 # checkpoint atomicity, preemption, auto-resume, retry, chaos; the budget is

@@ -7,12 +7,19 @@ implements the highest-signal checks directly on the AST/token stream:
   F401  imported name unused (module scope; respects __all__, ``# noqa``,
         conventional re-export via ``import x as x``)
   F811  import redefined before use
+  F841  local variable assigned but never used (plain ``name = ...`` and
+        ``with ... as name`` bindings; tuple unpacking, ``_``-prefixed names,
+        augmented assignments, and loop/except targets are exempt, matching
+        pyflakes' default latitude)
   E999  syntax error
   W291  trailing whitespace / W191 tab indentation
   E501  line too long (default 120, like the reference's setup.cfg)
 
 Per-file ignores (the flake8 ``per-file-ignores`` convention): ``__init__.py``
 files skip F401 — package re-export surface.
+
+The semantic (JAX/threading) checks live in ``trlx_tpu/analysis`` —
+``python -m trlx_tpu.analysis`` — and gate CI alongside this lint.
 
 Usage: python scripts/lint.py PATH [PATH...]
 Exit code 1 if any finding.
@@ -90,6 +97,61 @@ class ImportVisitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class UnusedLocalVisitor(ast.NodeVisitor):
+    """F841: per function scope, plain assignments whose name is never read.
+
+    A name counts as used if it is loaded anywhere in the function *or any
+    scope nested inside it* (closures legitimately read outer locals), or
+    ``del``-ed. Tuple unpacking is exempt (unpacking for effect/shape is
+    idiomatic), as are ``_``-prefixed names and ``for``/``except`` targets.
+    """
+
+    def __init__(self):
+        self.findings = []  # (lineno, name)
+
+    _NESTED = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+    def _check_scope(self, fn):
+        assigned = {}  # name -> first assignment lineno, THIS scope only
+        used = set()  # loads anywhere below (closures read outer locals)
+
+        def collect_assigns(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, self._NESTED):
+                    continue  # nested scopes own their bindings (and class
+                    # bodies are attributes, not locals)
+                if isinstance(child, ast.Assign):
+                    for t in child.targets:
+                        if isinstance(t, ast.Name) and not t.id.startswith("_"):
+                            assigned.setdefault(t.id, t.lineno)
+                elif isinstance(child, ast.AnnAssign) and child.value is not None:
+                    t = child.target
+                    if isinstance(t, ast.Name) and not t.id.startswith("_"):
+                        assigned.setdefault(t.id, t.lineno)
+                elif isinstance(child, (ast.With, ast.AsyncWith)):
+                    for item in child.items:
+                        v = item.optional_vars
+                        if isinstance(v, ast.Name) and not v.id.startswith("_"):
+                            assigned.setdefault(v.id, v.lineno)
+                collect_assigns(child)
+
+        collect_assigns(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, (ast.Load, ast.Del)):
+                used.add(node.id)
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                used.update(node.names)
+        for name, lineno in sorted(assigned.items(), key=lambda kv: kv[1]):
+            if name not in used:
+                self.findings.append((lineno, name))
+
+    def visit_FunctionDef(self, node):
+        self._check_scope(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
 def lint_file(path: Path):
     findings = []
     try:
@@ -140,6 +202,15 @@ def lint_file(path: Path):
         seen[name] = lineno
         if name not in v.used and name not in exported:
             findings.append((path, lineno, "F401", f"{name!r} imported but unused"))
+
+    # unused locals
+    uv = UnusedLocalVisitor()
+    uv.visit(tree)
+    for lineno, name in uv.findings:
+        if lineno not in noqa:
+            findings.append(
+                (path, lineno, "F841", f"local variable {name!r} is assigned to but never used")
+            )
     return findings
 
 

@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Fast local pre-commit: lint + graftcheck on CHANGED .py files only.
+#
+#   bash scripts/precommit.sh [BASE]
+#
+# BASE defaults to HEAD: staged + unstaged + untracked changes are checked.
+# Pass a ref (e.g. main) to check everything that differs from that ref.
+# Full-tree equivalents run in scripts/ci.sh; this is the seconds-fast loop.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+BASE="${1:-HEAD}"
+
+# changed-or-added tracked files vs BASE, plus untracked ones; deletions drop
+# out via --diff-filter (a deleted file cannot be linted)
+mapfile -t changed < <(
+    {
+        git diff --name-only --diff-filter=d "$BASE" -- '*.py'
+        git ls-files --others --exclude-standard -- '*.py'
+    } | sort -u
+)
+
+files=()
+for f in "${changed[@]}"; do
+    [[ -f "$f" ]] && files+=("$f")
+done
+
+if [[ ${#files[@]} -eq 0 ]]; then
+    echo "precommit: no changed .py files vs $BASE"
+    exit 0
+fi
+
+echo "precommit: checking ${#files[@]} changed file(s) vs $BASE"
+printf '  %s\n' "${files[@]}"
+
+echo "== lint"
+python scripts/lint.py "${files[@]}"
+
+echo "== graftcheck"
+# baseline keys are repo-root-relative (the same paths ci.sh uses), so the
+# committed baseline applies unchanged to a partial file list
+JAX_PLATFORMS=cpu python -m trlx_tpu.analysis "${files[@]}"
+
+echo "precommit OK"

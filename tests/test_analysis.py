@@ -1,0 +1,757 @@
+"""graftcheck (trlx_tpu/analysis): every rule's positive and negative
+fixtures, noqa suppression, baseline round-trip, CLI exit codes, and the
+F841 addition to scripts/lint.py.
+
+Fixture snippets are written to tmp_path and checked through the public
+``run()`` entry so the full pipeline (parse -> aliases -> rules -> noqa) is
+exercised, not just the rule internals.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from trlx_tpu.analysis import RULES, run
+from trlx_tpu.analysis import baseline as baseline_mod
+from trlx_tpu.analysis.cli import main as cli_main
+
+pytestmark = pytest.mark.analysis
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def check_snippet(tmp_path, source, name="snippet.py", select=None):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    return run([str(f)], select=select)
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+# ----------------------------------------------------------------- registry
+
+
+def test_all_six_rules_registered():
+    assert {"JX001", "JX002", "JX003", "JX004", "TH001", "TH002"} <= set(RULES)
+    for rule in RULES.values():
+        assert rule.summary
+
+
+# ------------------------------------------------------------------- JX001
+
+
+def test_jx001_key_reuse_positive(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def f(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.uniform(key, (2,))
+            return a + b
+        """,
+    )
+    assert rule_ids(findings) == ["JX001"]
+    assert "reused" in findings[0].message
+
+
+def test_jx001_split_rebind_is_clean(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def f(key):
+            key, sub = jax.random.split(key)
+            a = jax.random.normal(sub, (2,))
+            key, sub = jax.random.split(key)
+            return a + jax.random.uniform(sub, (2,))
+        """,
+    )
+    assert findings == []
+
+
+def test_jx001_fold_in_rebind_is_clean(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def f(key, n):
+            out = []
+            for i in range(n):
+                sub = jax.random.fold_in(key, i)
+                out.append(jax.random.normal(sub, (2,)))
+            return out
+        """,
+    )
+    assert findings == []
+
+
+def test_jx001_loop_reuse_positive(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def f(key, xs):
+            out = []
+            for x in xs:
+                out.append(jax.random.normal(key, (2,)))
+            return out
+        """,
+    )
+    assert rule_ids(findings) == ["JX001"]
+
+
+def test_jx001_early_return_branches_are_independent(tmp_path):
+    # the sampling.py shape: consume in a returning branch, then consume on
+    # the fallthrough path — only one of the two ever runs
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def f(key, flag):
+            if flag:
+                return jax.random.normal(key, (2,))
+            return jax.random.uniform(key, (2,))
+        """,
+    )
+    assert findings == []
+
+
+def test_jx001_attribute_keys_tracked(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        class T:
+            def gen(self):
+                a = jax.random.normal(self.rng, (2,))
+                b = jax.random.normal(self.rng, (2,))
+                return a + b
+
+            def gen_ok(self):
+                self.rng, sub = jax.random.split(self.rng)
+                return jax.random.normal(sub, (2,))
+        """,
+    )
+    assert rule_ids(findings) == ["JX001"]
+    assert findings[0].lineno == 7
+
+
+def test_jx001_aliased_import(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        from jax import random as jrandom
+
+        def f(key):
+            a = jrandom.normal(key, (2,))
+            return a + jrandom.gumbel(key, (2,))
+        """,
+    )
+    assert rule_ids(findings) == ["JX001"]
+
+
+# ------------------------------------------------------------------- JX002
+
+
+def test_jx002_host_sync_in_decorated_jit(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return float(x) + x.sum().item() + np.asarray(x).mean()
+        """,
+    )
+    assert rule_ids(findings) == ["JX002"] * 3
+
+
+def test_jx002_wrapped_and_transitive(tmp_path):
+    # jax.jit(step) taints step AND the helper it calls
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def helper(x):
+            jax.device_get(x)
+            return x
+
+        def step(x):
+            return helper(x) * 2
+
+        fast = jax.jit(step)
+        """,
+    )
+    assert rule_ids(findings) == ["JX002"]
+    assert "device_get" in findings[0].message
+
+
+def test_jx002_host_sync_outside_jit_is_clean(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        def host_side(x):
+            return np.asarray(jax.device_get(x)).item()
+        """,
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------------------- JX003
+
+
+def test_jx003_impure_ops(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import time
+        import jax
+
+        @jax.jit
+        def f(x):
+            print("tracing")
+            t = time.time()
+            return x * t
+        """,
+    )
+    assert rule_ids(findings) == ["JX003"] * 2
+
+
+def test_jx003_attribute_mutation_under_jit(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        class T:
+            def build(self):
+                def step(x):
+                    self.count = self.count + 1
+                    return x
+                return jax.jit(step)
+        """,
+    )
+    assert rule_ids(findings) == ["JX003"]
+    assert "mutation" in findings[0].message
+
+
+def test_jx003_clean_jit_body(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return jnp.sum(x * 2)
+        """,
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------------------- JX004
+
+
+def test_jx004_branch_on_traced_param(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def make():
+            def step(params, batch):
+                if params > 0:
+                    return batch
+                return -batch
+            return jax.jit(step)
+        """,
+    )
+    assert rule_ids(findings) == ["JX004"]
+    assert "lax.cond" in findings[0].message
+
+
+def test_jx004_propagates_through_assignment(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            y = jnp.sum(x)
+            while y > 0:
+                y = y - 1
+            return y
+        """,
+    )
+    assert rule_ids(findings) == ["JX004"]
+
+
+def test_jx004_shape_and_none_checks_are_static(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x, mask=None):
+            if x.shape[0] > 1 and len(x) > 1:
+                x = x * 2
+            if mask is not None:
+                x = x * mask
+            return jnp.sum(x)
+        """,
+    )
+    assert findings == []
+
+
+def test_jx004_defaulted_params_are_static(tmp_path):
+    # config-style defaulted/kw-only params branch freely (jit static args)
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def f(x, temperature=1.0, *, top_k=0):
+            if temperature == 0 or top_k > 0:
+                return x * 2
+            return x
+        """,
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------------------- TH001
+
+
+def test_th001_unlocked_read(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+
+            def incr(self):
+                with self._lock:
+                    self._count += 1
+
+            def peek(self):
+                return self._count
+        """,
+    )
+    assert rule_ids(findings) == ["TH001"]
+    assert "peek" in findings[0].message
+
+
+def test_th001_container_mutation_counts_as_write(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def push(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def drain(self):
+                out = list(self._items)
+                return out
+        """,
+    )
+    assert rule_ids(findings) == ["TH001"]
+
+
+def test_th001_init_and_locked_access_are_clean(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+
+            def incr(self):
+                with self._lock:
+                    self._count += 1
+
+            def peek(self):
+                with self._lock:
+                    return self._count
+        """,
+    )
+    assert findings == []
+
+
+def test_th001_unguarded_attrs_do_not_flag(tmp_path):
+    # attribute never written under a lock -> no discipline declared
+    findings = check_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.mode = "a"
+
+            def set_mode(self, m):
+                self.mode = m
+
+            def get_mode(self):
+                return self.mode
+        """,
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------------------- TH002
+
+
+def test_th002_thread_without_daemon_or_join(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import threading
+
+        def spawn():
+            t = threading.Thread(target=print)
+            t.start()
+            return t
+        """,
+    )
+    assert rule_ids(findings) == ["TH002"]
+
+
+def test_th002_daemon_or_join_are_clean(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import threading
+
+        def daemonized():
+            t = threading.Thread(target=print, daemon=True)
+            t.start()
+
+        def joined():
+            t = threading.Thread(target=print)
+            t.start()
+            t.join()
+        """,
+    )
+    assert findings == []
+
+
+def test_th002_join_via_loop_over_collection(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import threading
+
+        def fan_out(n):
+            threads = [threading.Thread(target=print) for _ in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        """,
+    )
+    assert findings == []
+
+
+# -------------------------------------------------------------- suppression
+
+
+def test_noqa_suppresses_one_rule(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def f(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.uniform(key, (2,))  # graftcheck: noqa[JX001]
+            return a + b
+        """,
+    )
+    assert findings == []
+
+
+def test_noqa_wrong_rule_does_not_suppress(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def f(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.uniform(key, (2,))  # graftcheck: noqa[TH001]
+            return a + b
+        """,
+    )
+    assert rule_ids(findings) == ["JX001"]
+
+
+def test_bare_noqa_suppresses_everything(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def f(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.uniform(key, (2,))  # graftcheck: noqa
+            return a + b
+        """,
+    )
+    assert findings == []
+
+
+def test_noqa_inside_string_literal_is_not_a_suppression(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def f(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.uniform(key, (2,)); s = "# graftcheck: noqa"
+            return a + b + len(s)
+        """,
+    )
+    assert rule_ids(findings) == ["JX001"]
+
+
+# ----------------------------------------------------------------- baseline
+
+
+def test_baseline_round_trip(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        textwrap.dedent(
+            """
+            import jax
+
+            def f(key):
+                a = jax.random.normal(key, (2,))
+                b = jax.random.uniform(key, (2,))
+                return a + b
+            """
+        )
+    )
+    findings = run([str(src)])
+    assert len(findings) == 1
+
+    base_file = tmp_path / "baseline.txt"
+    baseline_mod.write(base_file, findings)
+    base = baseline_mod.load(base_file)
+    new, stale = baseline_mod.compare(findings, base)
+    assert new == [] and stale == []
+
+    # line-number drift does not invalidate the entry...
+    src.write_text("# a new comment line shifts everything\n" + src.read_text())
+    shifted = run([str(src)])
+    assert shifted[0].lineno != findings[0].lineno
+    new, stale = baseline_mod.compare(shifted, base)
+    assert new == [] and stale == []
+
+    # ...but editing the offending line does
+    src.write_text(src.read_text().replace("(2,))\n    return", "(3,))\n    return"))
+    edited = run([str(src)])
+    assert len(edited) == 1
+    new, stale = baseline_mod.compare(edited, base)
+    assert len(new) == 1 and len(stale) == 1
+
+
+def test_baseline_is_a_multiset(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        textwrap.dedent(
+            """
+            import jax
+
+            def f(key):
+                a = jax.random.normal(key, (2,))
+                b = jax.random.uniform(key, (2,))
+                return a + b
+
+            def g(key):
+                a = jax.random.normal(key, (2,))
+                b = jax.random.uniform(key, (2,))
+                return a + b
+            """
+        )
+    )
+    findings = run([str(src)])
+    assert len(findings) == 2
+    # identical code text in f and g -> identical keys; one baseline entry
+    # must cover exactly one of them
+    assert findings[0].key() == findings[1].key()
+    base_file = tmp_path / "baseline.txt"
+    baseline_mod.write(base_file, findings[:1])
+    new, _ = baseline_mod.compare(findings, baseline_mod.load(base_file))
+    assert len(new) == 1
+
+
+def test_baseline_justification_comment_is_stripped(tmp_path):
+    line = "pkg/mod.py:JX001:b = jax.random.uniform(key, (2,))  # legacy, removing in PR 9"
+    assert baseline_mod.parse_line(line) == "pkg/mod.py:JX001:b = jax.random.uniform(key, (2,))"
+
+
+# ---------------------------------------------------------------------- CLI
+
+
+def test_cli_exit_codes_and_write_baseline(tmp_path, capsys, monkeypatch):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import jax\n\ndef f(k):\n    a = jax.random.normal(k, (2,))\n"
+        "    return a + jax.random.gumbel(k, (2,))\n"
+    )
+    base = tmp_path / "base.txt"
+
+    assert cli_main([str(src), "--baseline", str(base)]) == 1
+    out = capsys.readouterr().out
+    assert "JX001" in out and "1 new" in out
+
+    assert cli_main([str(src), "--baseline", str(base), "--write-baseline"]) == 0
+    assert cli_main([str(src), "--baseline", str(base)]) == 0
+    out = capsys.readouterr().out
+    assert "0 new" in out and "1 baselined" in out
+
+    # clean file under the same baseline: finding gone -> stale entry warned
+    src.write_text("import jax\n\ndef f(k):\n    return jax.random.normal(k, (2,))\n")
+    assert cli_main([str(src), "--baseline", str(base)]) == 0
+    out = capsys.readouterr().out
+    assert "stale" in out
+
+
+def test_cli_select_and_unknown_rule(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text("import threading\n\nt = threading.Thread(target=print)\nt.start()\n")
+    assert cli_main([str(src), "--no-baseline", "--select", "JX001"]) == 0
+    assert cli_main([str(src), "--no-baseline", "--select", "TH002"]) == 1
+    assert cli_main([str(src), "--no-baseline", "--select", "NOPE"]) == 2
+
+
+def test_cli_list_rules(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("JX001", "JX002", "JX003", "JX004", "TH001", "TH002"):
+        assert rid in out
+
+
+def test_cli_syntax_error_is_gc000(tmp_path):
+    src = tmp_path / "broken.py"
+    src.write_text("def f(:\n")
+    assert cli_main([str(src), "--no-baseline"]) == 1
+
+
+# ----------------------------------------------------- repo-level contract
+
+
+@pytest.mark.slow
+def test_repo_tree_is_graftcheck_clean():
+    """The acceptance-criteria command: the merged tree has no new findings."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "trlx_tpu.analysis", "trlx_tpu", "tests", "examples", "scripts"],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -------------------------------------------------------------- lint F841
+
+
+def lint_snippet(tmp_path, source, name="mod.py"):
+    sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+    try:
+        import lint
+    finally:
+        sys.path.pop(0)
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    return lint.lint_file(f)
+
+
+def test_f841_flags_unused_local(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        def f():
+            x = 1
+            y = 2
+            return y
+        """,
+    )
+    assert [(code, msg.split("'")[1]) for _, _, code, msg in findings] == [("F841", "x")]
+
+
+def test_f841_exemptions(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        def f():
+            _scratch = 1          # underscore-prefixed
+            a, b = 1, 2           # tuple unpack
+            for i in range(3):    # loop target
+                pass
+
+            def inner():
+                return captured   # closure read
+
+            captured = 9
+            return inner
+
+        def g():
+            class Holder:
+                attr = 5          # class attribute, not a local
+            return Holder
+        """,
+    )
+    assert [f for f in findings if f[2] == "F841"] == []
+
+
+def test_f841_noqa(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        def f():
+            x = 1  # noqa
+            return 0
+        """,
+    )
+    assert [f for f in findings if f[2] == "F841"] == []

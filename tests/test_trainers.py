@@ -529,7 +529,7 @@ def test_ppo_resume_and_continue_training(tmp_path):
         )
 
     prompts = ["ab", "cd ef", "gh", "a b c"] * 2
-    trainer = trlx_tpu.train(reward_fn=dog_reward, prompts=prompts, config=cfg(3))
+    trlx_tpu.train(reward_fn=dog_reward, prompts=prompts, config=cfg(3))
     ckpt = str(tmp_path / "ckpts" / "checkpoint_2")
     assert os.path.isdir(ckpt)
 

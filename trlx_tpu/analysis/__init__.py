@@ -1,0 +1,46 @@
+"""graftcheck: JAX- and concurrency-aware static analysis for this repo.
+
+``scripts/lint.py`` enforces the flake8-shaped style subset; this package
+enforces the *semantic* hazards that style lint cannot see — the bug classes
+the async rollout engine, the checkpoint writer thread, and the jitted hot
+paths introduced (docs/static-analysis.md documents every rule with an
+offending example and its fix):
+
+======  ==============================================================
+JX001   jax.random key reuse without an intervening split/fold_in
+JX002   host-device sync (.item(), float(), np.asarray, device_get,
+        block_until_ready) reachable inside jit-traced code
+JX003   impure ops under jit (clock reads, print/logging, global or
+        attribute mutation) — trace-time-only execution
+JX004   Python if/while branching on a traced array value
+TH001   lock-guarded attribute accessed without the lock elsewhere
+TH002   threading.Thread with neither daemon= nor a reachable join()
+======  ==============================================================
+
+Run: ``python -m trlx_tpu.analysis PATH...`` (exit 1 on new findings).
+Suppress per line with ``# graftcheck: noqa[RULE]``; grandfather with a
+justified entry in ``graftcheck-baseline.txt``.
+"""
+
+from trlx_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    FileContext,
+    RULES,
+    Rule,
+    check_file,
+    load_context,
+    register,
+    run,
+)
+from trlx_tpu.analysis import rules_jax, rules_threads  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "FileContext",
+    "RULES",
+    "Rule",
+    "check_file",
+    "load_context",
+    "register",
+    "run",
+]

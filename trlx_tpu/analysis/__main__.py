@@ -1,0 +1,7 @@
+"""``python -m trlx_tpu.analysis`` entry point (see cli.py for the flags)."""
+
+import sys
+
+from trlx_tpu.analysis.cli import main
+
+sys.exit(main())

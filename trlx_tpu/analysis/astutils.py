@@ -1,0 +1,237 @@
+"""Shared AST reasoning for graftcheck rules: import aliases, dotted names,
+parent maps, and — the piece every JX rule leans on — *traced-function
+discovery*: which function bodies in a file execute under ``jax.jit``/``pjit``
+tracing, whether via decorator, wrapper call, or same-file transitive call.
+
+Everything here is per-file. Cross-module tracing (a trainer jitting a
+function imported from ``ops/``) is out of scope by design: the importing
+file sees the ``jax.jit(...)`` call but not the body, the defining file sees
+the body but not the jit — each file is judged on what it can prove locally,
+which keeps the rules precise instead of speculative.
+"""
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set
+
+#: jax.random functions that CONSUME a key: feeding the same key to two of
+#: these yields correlated (usually identical) streams. ``fold_in`` is absent
+#: on purpose — folding distinct data into one key is the idiomatic way to
+#: derive many keys, not a reuse.
+JAX_RANDOM_CONSUMERS = frozenset(
+    {
+        "ball", "bernoulli", "beta", "binomial", "categorical", "cauchy",
+        "chisquare", "choice", "dirichlet", "double_sided_maxwell",
+        "exponential", "f", "gamma", "generalized_normal", "geometric",
+        "gumbel", "laplace", "loggamma", "logistic", "lognormal", "maxwell",
+        "multivariate_normal", "normal", "orthogonal", "pareto", "permutation",
+        "poisson", "rademacher", "randint", "rayleigh", "shuffle", "split",
+        "t", "triangular", "truncated_normal", "uniform", "wald", "weibull_min",
+    }
+)
+
+#: jax.random functions that PRODUCE a fresh key (assigning their result
+#: re-arms the target name for another consumption).
+JAX_RANDOM_PRODUCERS = frozenset({"PRNGKey", "key", "split", "fold_in", "clone", "wrap_key_data"})
+
+
+@dataclass
+class Aliases:
+    """Names each interesting module/function is bound to in one file."""
+
+    jax: Set[str] = field(default_factory=set)
+    jax_random: Set[str] = field(default_factory=set)
+    numpy: Set[str] = field(default_factory=set)
+    time: Set[str] = field(default_factory=set)
+    threading: Set[str] = field(default_factory=set)
+    jit: Set[str] = field(default_factory=set)  # names bound to jit/pjit callables
+    partial: Set[str] = field(default_factory=set)
+    thread_class: Set[str] = field(default_factory=set)  # `from threading import Thread`
+    lock_factories: Set[str] = field(default_factory=set)  # `from threading import Lock`
+
+
+_LOCK_FACTORY_NAMES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+
+def collect_aliases(tree: ast.Module) -> Aliases:
+    al = Aliases()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                bound = a.asname or a.name.split(".")[0]
+                if a.name == "jax" or (a.asname is None and a.name.startswith("jax.")):
+                    al.jax.add(bound)
+                if a.name == "jax.random" and a.asname:
+                    al.jax_random.add(bound)
+                if a.name == "numpy":
+                    al.numpy.add(bound)
+                if a.name == "time":
+                    al.time.add(bound)
+                if a.name == "threading":
+                    al.threading.add(bound)
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for a in node.names:
+                bound = a.asname or a.name
+                if mod == "jax" and a.name == "random":
+                    al.jax_random.add(bound)
+                elif mod == "jax" and a.name in ("jit", "pjit"):
+                    al.jit.add(bound)
+                elif mod in ("jax.experimental.pjit", "jax.experimental") and a.name == "pjit":
+                    al.jit.add(bound)
+                elif mod == "functools" and a.name == "partial":
+                    al.partial.add(bound)
+                elif mod == "threading" and a.name == "Thread":
+                    al.thread_class.add(bound)
+                elif mod == "threading" and a.name in _LOCK_FACTORY_NAMES:
+                    al.lock_factories.add(bound)
+    return al
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def build_parents(tree: ast.Module) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def jax_random_fn(call: ast.Call, al: Aliases) -> Optional[str]:
+    """``'normal'`` for ``jax.random.normal(...)`` / ``jrandom.normal(...)``
+    / ``random.normal(...)`` (when bound from jax), else None."""
+    fn = call.func
+    if not isinstance(fn, ast.Attribute):
+        return None
+    base = dotted(fn.value)
+    if base is None:
+        return None
+    if base in al.jax_random:
+        return fn.attr
+    root = base.split(".")[0]
+    if root in al.jax and base == f"{root}.random":
+        return fn.attr
+    return None
+
+
+def is_jit_ref(node: ast.AST, al: Aliases) -> bool:
+    """True for an expression denoting the jit/pjit transform itself."""
+    if isinstance(node, ast.Name):
+        return node.id in al.jit
+    d = dotted(node)
+    if d is None:
+        return False
+    root = d.split(".")[0]
+    if root in al.jax and d.split(".")[-1] in ("jit", "pjit"):
+        return True
+    return d in ("pjit.pjit",)
+
+
+def _jit_call_target(call: ast.Call, al: Aliases) -> Optional[ast.AST]:
+    """For ``jax.jit(f, ...)`` / ``pjit(f, ...)`` / ``partial(jax.jit, ...)(f)``,
+    the wrapped function expression (Name or Lambda), else None."""
+    if is_jit_ref(call.func, al) and call.args:
+        return call.args[0]
+    # partial(jax.jit, static_argnums=...)(f) — rare, handled for completeness
+    if (
+        isinstance(call.func, ast.Call)
+        and isinstance(call.func.func, (ast.Name, ast.Attribute))
+        and is_jit_ref(call.func.func, al)
+        and call.args
+    ):
+        return call.args[0]
+    return None
+
+
+def _decorated_jit(fn: ast.AST, al: Aliases) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        if is_jit_ref(dec, al):
+            return True
+        if isinstance(dec, ast.Call):
+            # @jax.jit(...) and @partial(jax.jit, ...) / @functools.partial(jit, ...)
+            if is_jit_ref(dec.func, al):
+                return True
+            fname = dotted(dec.func)
+            is_partial = (
+                isinstance(dec.func, ast.Name) and dec.func.id in al.partial
+            ) or (fname is not None and fname.endswith(".partial"))
+            if is_partial and dec.args and is_jit_ref(dec.args[0], al):
+                return True
+    return False
+
+
+def traced_functions(tree: ast.Module, al: Aliases) -> Set[ast.AST]:
+    """FunctionDef/AsyncFunctionDef/Lambda nodes whose bodies run under trace:
+
+    - decorated with ``@jit``/``@pjit``/``@partial(jit, ...)``;
+    - wrapped anywhere in the file: ``jax.jit(step)``, ``jax.jit(lambda ...)``;
+    - called (by bare name, same file) from an already-traced body, to a
+      fixpoint — ``jax.jit(step)`` taints the helper ``body`` that ``step``
+      calls, which is how "reachable inside jit" is approximated.
+    """
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    traced: Set[ast.AST] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and _decorated_jit(node, al):
+            traced.add(node)
+        elif isinstance(node, ast.Call):
+            target = _jit_call_target(node, al)
+            if isinstance(target, ast.Lambda):
+                traced.add(target)
+            elif isinstance(target, ast.Name):
+                traced.update(defs_by_name.get(target.id, []))
+
+    # transitive closure over same-file bare-name calls
+    changed = True
+    while changed:
+        changed = False
+        for fn in list(traced):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                    for callee in defs_by_name.get(node.func.id, []):
+                        if callee not in traced:
+                            traced.add(callee)
+                            changed = True
+    return traced
+
+
+def traced_roots(tree: ast.Module, al: Aliases) -> List[ast.AST]:
+    """The traced set minus functions nested inside another traced function —
+    walking each root's subtree visits every traced statement exactly once."""
+    traced = traced_functions(tree, al)
+    roots = []
+    for fn in traced:
+        nested = False
+        for other in traced:
+            if other is fn:
+                continue
+            for node in ast.walk(other):
+                if node is fn:
+                    nested = True
+                    break
+            if nested:
+                break
+        if not nested:
+            roots.append(fn)
+    return sorted(roots, key=lambda n: getattr(n, "lineno", 0))
+
+
+def iter_functions(tree: ast.Module) -> Iterable[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            yield node

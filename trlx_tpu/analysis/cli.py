@@ -1,0 +1,80 @@
+"""graftcheck command line.
+
+Usage::
+
+    python -m trlx_tpu.analysis PATH [PATH...] [options]
+
+Options:
+    --baseline FILE      baseline file (default: graftcheck-baseline.txt,
+                         resolved against the current directory)
+    --no-baseline        ignore the baseline (report every finding as new)
+    --write-baseline     rewrite the baseline from the current findings and
+                         exit 0 (each entry gets a TODO justification)
+    --select R1,R2       run only the listed rule ids
+    --list-rules         print the rule registry and exit
+
+Exit status: 1 if any *new* finding (not noqa'd, not baselined), else 0 —
+this is the contract ``scripts/ci.sh`` gates on.
+"""
+
+import argparse
+import sys
+
+from trlx_tpu.analysis import baseline as baseline_mod
+from trlx_tpu.analysis.core import RULES, run
+
+DEFAULT_BASELINE = "graftcheck-baseline.txt"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m trlx_tpu.analysis",
+        description="graftcheck: JAX- and concurrency-aware static analysis",
+    )
+    parser.add_argument("paths", nargs="*", default=["trlx_tpu"])
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE)
+    parser.add_argument("--no-baseline", action="store_true")
+    parser.add_argument("--write-baseline", action="store_true")
+    parser.add_argument("--select", default=None, help="comma-separated rule ids")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    # populate the registry for --list-rules before any file is scanned
+    from trlx_tpu.analysis import rules_jax, rules_threads  # noqa: F401
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            print(f"{rid}  {RULES[rid].summary}")
+        return 0
+
+    select = None
+    if args.select:
+        select = [r.strip() for r in args.select.split(",") if r.strip()]
+    try:
+        findings = run(args.paths or ["trlx_tpu"], select=select)
+    except ValueError as e:
+        print(f"graftcheck: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        n = baseline_mod.write(args.baseline, findings)
+        print(f"graftcheck: wrote {n} baseline entries to {args.baseline}")
+        return 0
+
+    base = baseline_mod.load("/dev/null" if args.no_baseline else args.baseline)
+    new, stale = baseline_mod.compare(findings, base)
+
+    for f in new:
+        print(f)
+    for key in stale:
+        print(f"graftcheck: stale baseline entry (fixed? remove it): {key}")
+    n_baselined = len(findings) - len(new)
+    print(
+        f"graftcheck: {len(findings)} finding(s) "
+        f"({len(new)} new, {n_baselined} baselined, {len(stale)} stale baseline)"
+    )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

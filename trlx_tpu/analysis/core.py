@@ -1,0 +1,204 @@
+"""graftcheck core: findings, rule registry, noqa suppression, file runner.
+
+``scripts/lint.py`` covers the flake8-shaped subset (unused imports, line
+length, syntax). graftcheck is the other half: *semantic* checks that need the
+AST plus a little flow reasoning — JAX tracing discipline (rules ``JX0xx``,
+:mod:`trlx_tpu.analysis.rules_jax`) and thread/lock discipline (rules
+``TH0xx``, :mod:`trlx_tpu.analysis.rules_threads`). The framework here is
+deliberately CFG-lite: rules see one file's AST at a time (plus per-file alias
+and parent maps from :mod:`trlx_tpu.analysis.astutils`) and approximate
+control flow with source order — precise enough for the hazards that matter
+(key reuse, host syncs under jit, unlocked shared state), cheap enough to run
+on every commit.
+
+Suppression layers, in order of preference:
+
+1. Fix the code.
+2. ``# graftcheck: noqa[RULE]`` on the offending line — for findings that are
+   *intentional* and local (e.g. a documented lock-free fast path). Bare
+   ``# graftcheck: noqa`` suppresses every rule on that line.
+3. The committed baseline file (:mod:`trlx_tpu.analysis.baseline`) — for
+   grandfathered findings, each carrying a one-line justification. New code
+   never lands in the baseline; the CI gate fails on any finding that is
+   neither suppressed nor baselined.
+"""
+
+import ast
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+#: ``# graftcheck: noqa`` / ``# graftcheck: noqa[JX001]`` / ``[JX001,TH002]``
+_NOQA_RE = re.compile(r"#\s*graftcheck:\s*noqa(?:\s*\[([A-Za-z0-9_,\s]+)\])?")
+
+#: Matches every rule on the line (bare ``noqa``).
+_ALL_RULES = "*"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source line."""
+
+    path: str  # as given on the command line (posix-normalized)
+    lineno: int
+    rule: str
+    message: str
+    line_text: str = ""  # stripped source line, the line-number-stable key
+
+    def key(self) -> str:
+        """Baseline identity: path + rule + code text, NOT the line number —
+        a finding must stay matched when unrelated edits shift it."""
+        return f"{self.path}:{self.rule}:{self.line_text}"
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.lineno}: {self.rule} {self.message}"
+
+
+class Rule:
+    """A semantic check. Subclasses set ``id``/``summary`` and implement
+    :meth:`check` yielding :class:`Finding`s for one file."""
+
+    id: str = ""
+    summary: str = ""
+
+    def check(self, ctx: "FileContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: "FileContext", node: ast.AST, message: str) -> Finding:
+        lineno = getattr(node, "lineno", 0)
+        return Finding(
+            path=ctx.rel,
+            lineno=lineno,
+            rule=self.id,
+            message=message,
+            line_text=ctx.line(lineno),
+        )
+
+
+#: rule id -> rule instance; populated by :func:`register` at import time.
+RULES: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator adding a rule to the global registry."""
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if inst.id in RULES:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    RULES[inst.id] = inst
+    return cls
+
+
+@dataclass
+class FileContext:
+    """Everything a rule may need about one file, parsed once."""
+
+    path: Path
+    rel: str
+    source: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+    noqa: Dict[int, Set[str]] = field(default_factory=dict)
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppressed(self, finding: Finding) -> bool:
+        rules = self.noqa.get(finding.lineno)
+        if rules is None:
+            return False
+        return _ALL_RULES in rules or finding.rule in rules
+
+
+def _parse_noqa(source: str) -> Dict[int, Set[str]]:
+    """Line -> suppressed rule ids, via the token stream so ``graftcheck:
+    noqa`` inside a string literal is not a suppression."""
+    noqa: Dict[int, Set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(iter(source.splitlines(True)).__next__)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _NOQA_RE.search(tok.string)
+            if not m:
+                continue
+            rules = noqa.setdefault(tok.start[0], set())
+            if m.group(1) is None:
+                rules.add(_ALL_RULES)
+            else:
+                rules.update(r.strip() for r in m.group(1).split(",") if r.strip())
+    except (tokenize.TokenizeError, IndentationError):
+        pass
+    return noqa
+
+
+def load_context(path: Path, rel: Optional[str] = None) -> Optional[FileContext]:
+    """Parse one file into a :class:`FileContext`; None when unreadable
+    (the caller reports syntax errors through a finding instead)."""
+    source = path.read_text()
+    tree = ast.parse(source, filename=str(path))
+    return FileContext(
+        path=path,
+        rel=rel if rel is not None else path.as_posix(),
+        source=source,
+        tree=tree,
+        lines=source.splitlines(),
+        noqa=_parse_noqa(source),
+    )
+
+
+def iter_py_files(paths: Sequence) -> Iterable[Path]:
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if "__pycache__" not in f.parts:
+                    yield f
+        elif p.suffix == ".py":
+            yield p
+
+
+def check_file(ctx: FileContext, rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Run ``rules`` (default: all registered) over one parsed file,
+    dropping noqa-suppressed findings."""
+    out: List[Finding] = []
+    for rule in rules if rules is not None else RULES.values():
+        for f in rule.check(ctx):
+            if not ctx.suppressed(f):
+                out.append(f)
+    out.sort(key=lambda f: (f.lineno, f.rule))
+    return out
+
+
+def run(paths: Sequence, select: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Check every ``.py`` under ``paths``; unparseable files yield a single
+    ``GC000`` finding (lint.py owns the pretty E999, this keeps graftcheck
+    standalone)."""
+    # rules register on import; import here so `from analysis.core import run`
+    # alone is enough to get the full registry
+    from trlx_tpu.analysis import rules_jax, rules_threads  # noqa: F401
+
+    rules: Optional[List[Rule]] = None
+    if select is not None:
+        unknown = set(select) - set(RULES)
+        if unknown:
+            raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
+        rules = [RULES[r] for r in select]
+    findings: List[Finding] = []
+    for f in iter_py_files(paths):
+        rel = f.as_posix()
+        try:
+            ctx = load_context(f, rel)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            lineno = getattr(e, "lineno", 0) or 0
+            findings.append(
+                Finding(path=rel, lineno=lineno, rule="GC000", message=f"unparseable: {e}")
+            )
+            continue
+        findings.extend(check_file(ctx, rules))
+    return findings

@@ -1,0 +1,427 @@
+"""graftcheck JAX rules: tracing and RNG discipline.
+
+JX001  jax.random key reuse — the same key fed to two PRNG consumers without
+       an intervening ``split``/``fold_in`` rebind. Reuse silently correlates
+       the two draws (often byte-identical), which in RLHF means duplicated
+       rollouts and quietly broken exploration.
+JX002  host sync inside traced code — ``.item()``, ``float(arr)``,
+       ``np.asarray``/``np.array``, ``jax.device_get``,
+       ``block_until_ready`` reachable inside a jitted function either fail
+       at trace time (ConcretizationTypeError) or, worse, silently force a
+       device round-trip per call when tracing is staged out.
+JX003  impure ops under jit — wall-clock reads, ``print``/logging, ``global``
+       writes, and attribute mutation execute once at TRACE time, not per
+       step: the code reads like per-step behavior and does nothing at runtime.
+JX004  Python branching on a traced value — ``if``/``while`` on an array
+       forces a concretization error (or an unintended recompile per value
+       with static args); the fix is ``lax.cond``/``lax.while_loop`` or
+       ``jnp.where``.
+
+All four rules key off :func:`trlx_tpu.analysis.astutils.traced_functions`
+except JX001, which applies everywhere keys flow (key reuse is just as wrong
+in host-side rollout orchestration as under jit).
+
+Flow model (CFG-lite, shared with the module docstring of ``core``):
+statements are processed in source order; ``if``/``else`` branches are
+analyzed independently from the pre-branch state and their consumed-sets
+unioned; loop bodies are processed twice so a consumption that survives one
+iteration collides with itself on the next — the cheapest faithful
+approximation of "reused across iterations without a split".
+"""
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from trlx_tpu.analysis import astutils
+from trlx_tpu.analysis.core import FileContext, Finding, Rule, register
+from trlx_tpu.analysis.astutils import (
+    JAX_RANDOM_CONSUMERS,
+    collect_aliases,
+    dotted,
+    jax_random_fn,
+    traced_functions,
+    traced_roots,
+)
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+def _terminates(body: List[ast.stmt]) -> bool:
+    """True when a block cannot fall through (ends in return/raise/continue/
+    break) — CFG-lite reachability for the branch merge."""
+    if not body:
+        return False
+    return isinstance(body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+def _key_arg(call: ast.Call) -> Optional[str]:
+    """The dotted name of the key argument of a jax.random call, if it is a
+    plain name/attribute (``sub``, ``self.rng``)."""
+    if call.args:
+        return dotted(call.args[0])
+    for kw in call.keywords:
+        if kw.arg in ("key", "rng", "seed"):
+            return dotted(kw.value)
+    return None
+
+
+class _KeyFlow:
+    """Source-order key-consumption tracker for one scope (see module doc)."""
+
+    def __init__(self, rule: "JX001KeyReuse", ctx: FileContext, al):
+        self.rule = rule
+        self.ctx = ctx
+        self.al = al
+        self.findings: List[Finding] = []
+        self._flagged: Set[int] = set()  # node ids, dedups the loop double-pass
+
+    def run(self, body: List[ast.stmt]) -> Dict[str, Tuple[int, str]]:
+        return self._block(body, {})
+
+    # consumed: key name -> (lineno, consumer fn) of the consuming call
+    def _block(self, body, consumed):
+        for stmt in body:
+            consumed = self._stmt(stmt, consumed)
+        return consumed
+
+    def _stmt(self, stmt, consumed):
+        if isinstance(stmt, _SCOPE_NODES):
+            return consumed  # nested scopes are analyzed on their own
+        if isinstance(stmt, ast.If):
+            self._exprs([stmt.test], consumed)
+            after_body = self._block(stmt.body, dict(consumed))
+            after_else = self._block(stmt.orelse, dict(consumed))
+            # a branch that cannot fall through contributes nothing to the
+            # post-If state (the classic `if cond: ... return` early exit)
+            body_exits = _terminates(stmt.body)
+            else_exits = _terminates(stmt.orelse)
+            if body_exits and else_exits:
+                return consumed
+            if body_exits:
+                return after_else
+            if else_exits:
+                return after_body
+            merged = dict(after_body)
+            merged.update(after_else)
+            return merged
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._exprs([stmt.iter], consumed)
+            consumed = self._block(stmt.body, consumed)
+            consumed = self._block(stmt.body, consumed)  # cross-iteration reuse
+            return self._block(stmt.orelse, consumed)
+        if isinstance(stmt, ast.While):
+            self._exprs([stmt.test], consumed)
+            consumed = self._block(stmt.body, consumed)
+            self._exprs([stmt.test], consumed)
+            consumed = self._block(stmt.body, consumed)
+            return self._block(stmt.orelse, consumed)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._exprs([item.context_expr for item in stmt.items], consumed)
+            return self._block(stmt.body, consumed)
+        if isinstance(stmt, ast.Try):
+            consumed = self._block(stmt.body, consumed)
+            for h in stmt.handlers:
+                consumed = self._block(h.body, dict(consumed))
+            consumed = self._block(stmt.orelse, consumed)
+            return self._block(stmt.finalbody, consumed)
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            values = [stmt.value] if getattr(stmt, "value", None) is not None else []
+            self._exprs(values, consumed)
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            for t in targets:
+                for name in self._target_names(t):
+                    consumed.pop(name, None)  # rebinding re-arms the key
+            return consumed
+        # everything else: scan embedded expressions in place
+        self._exprs(
+            [n for n in ast.iter_child_nodes(stmt) if isinstance(n, ast.expr)], consumed
+        )
+        return consumed
+
+    def _target_names(self, target) -> Iterable[str]:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                yield from self._target_names(elt)
+        else:
+            name = dotted(target)
+            if name:
+                yield name
+
+    def _exprs(self, exprs, consumed):
+        for expr in exprs:
+            if expr is None:
+                continue
+            for node in ast.walk(expr):
+                if isinstance(node, _SCOPE_NODES):
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = jax_random_fn(node, self.al)
+                if fn is None or fn not in JAX_RANDOM_CONSUMERS:
+                    continue
+                key = _key_arg(node)
+                if key is None:
+                    continue
+                if key in consumed:
+                    prev_line, prev_fn = consumed[key]
+                    if id(node) not in self._flagged:
+                        self._flagged.add(id(node))
+                        self.findings.append(
+                            self.rule.finding(
+                                self.ctx,
+                                node,
+                                f"PRNG key {key!r} reused: already consumed by "
+                                f"jax.random.{prev_fn} at line {prev_line}; "
+                                f"split() or fold_in() before reusing",
+                            )
+                        )
+                else:
+                    consumed[key] = (node.lineno, fn)
+
+
+@register
+class JX001KeyReuse(Rule):
+    id = "JX001"
+    summary = "jax.random key reused without an intervening split/fold_in"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        al = collect_aliases(ctx.tree)
+        if not (al.jax or al.jax_random):
+            return []
+        findings: List[Finding] = []
+        flow = _KeyFlow(self, ctx, al)
+        flow.run(ctx.tree.body)  # module level
+        for fn in astutils.iter_functions(ctx.tree):
+            body = fn.body if isinstance(fn.body, list) else []
+            flow.run(body)
+            if isinstance(fn, ast.Lambda):
+                flow._exprs([fn.body], {})
+        findings.extend(flow.findings)
+        return findings
+
+
+def _walk_traced(root: ast.AST) -> Iterable[ast.AST]:
+    """Every node in a traced function's subtree (nested defs included —
+    they execute under the same trace)."""
+    yield from ast.walk(root)
+
+
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception", "critical", "log"}
+
+
+@register
+class JX002HostSync(Rule):
+    id = "JX002"
+    summary = "host-device synchronization reachable inside jit-traced code"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        al = collect_aliases(ctx.tree)
+        if not (al.jax or al.jit):
+            return []
+        findings: List[Finding] = []
+        for root in traced_roots(ctx.tree, al):
+            fname = getattr(root, "name", "<lambda>")
+            for node in _walk_traced(root):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = self._host_sync(node, al)
+                if msg:
+                    findings.append(
+                        self.finding(
+                            ctx, node, f"{msg} inside jit-traced {fname!r} forces a host sync"
+                        )
+                    )
+        return findings
+
+    def _host_sync(self, call: ast.Call, al) -> Optional[str]:
+        fn = call.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr == "item" and not call.args and not call.keywords:
+                return ".item()"
+            if fn.attr == "block_until_ready":
+                return ".block_until_ready()"
+            d = dotted(fn)
+            if d is not None:
+                root = d.split(".")[0]
+                if root in al.jax and d == f"{root}.device_get":
+                    return "jax.device_get()"
+                if root in al.numpy and d in (f"{root}.asarray", f"{root}.array"):
+                    return f"{d}()"
+        elif isinstance(fn, ast.Name) and fn.id == "float" and len(call.args) == 1:
+            if isinstance(call.args[0], (ast.Name, ast.Attribute, ast.Subscript)):
+                return "float(<array>)"
+        return None
+
+
+@register
+class JX003ImpureJit(Rule):
+    id = "JX003"
+    summary = "impure operation (clock/print/log/mutation) inside jit-traced code"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        al = collect_aliases(ctx.tree)
+        if not (al.jax or al.jit):
+            return []
+        findings: List[Finding] = []
+        for root in traced_roots(ctx.tree, al):
+            fname = getattr(root, "name", "<lambda>")
+            for node in _walk_traced(root):
+                msg = None
+                if isinstance(node, ast.Call):
+                    msg = self._impure_call(node, al)
+                elif isinstance(node, ast.Global) and node is not root:
+                    msg = f"global {', '.join(node.names)}"
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                    for t in targets:
+                        if isinstance(t, ast.Attribute):
+                            msg = f"attribute mutation {dotted(t) or t.attr!r}"
+                            break
+                if msg:
+                    findings.append(
+                        self.finding(
+                            ctx,
+                            node,
+                            f"{msg} inside jit-traced {fname!r} runs at trace "
+                            f"time only (once), not per step",
+                        )
+                    )
+        return findings
+
+    def _impure_call(self, call: ast.Call, al) -> Optional[str]:
+        fn = call.func
+        if isinstance(fn, ast.Name) and fn.id == "print":
+            return "print()"
+        d = dotted(fn)
+        if d is None:
+            return None
+        parts = d.split(".")
+        if parts[0] in al.time and parts[-1] in (
+            "time", "monotonic", "perf_counter", "time_ns", "monotonic_ns", "sleep"
+        ):
+            return f"{d}()"
+        if (
+            len(parts) >= 2
+            and parts[-1] in _LOG_METHODS
+            and (parts[0] in ("logging", "logger", "log") or parts[-2].endswith("logger"))
+        ):
+            return f"{d}()"
+        return None
+
+
+_SAFE_ATTRS = {"shape", "ndim", "dtype", "size"}
+_SAFE_CALLS = {"len", "isinstance", "callable", "hasattr", "getattr", "type"}
+
+
+class _TracedNameFinder(ast.NodeVisitor):
+    """Names in a branch test that are traced AND not used in a shape-/type-
+    only way (``x.shape``, ``len(x)``, ``x is None`` are all static)."""
+
+    def __init__(self, traced: Set[str]):
+        self.traced = traced
+        self.hits: Set[str] = set()
+
+    def visit_Attribute(self, node):
+        if node.attr in _SAFE_ATTRS:
+            return
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        if isinstance(node.func, ast.Name) and node.func.id in _SAFE_CALLS:
+            return
+        self.generic_visit(node)
+
+    def visit_Compare(self, node):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return
+        self.generic_visit(node)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load) and node.id in self.traced:
+            self.hits.add(node.id)
+
+
+@register
+class JX004TracerBranch(Rule):
+    id = "JX004"
+    summary = "Python if/while on a traced array value inside jit"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        al = collect_aliases(ctx.tree)
+        if not (al.jax or al.jit):
+            return []
+        findings: List[Finding] = []
+        for fn in sorted(traced_functions(ctx.tree, al), key=lambda n: n.lineno):
+            findings.extend(self._check_fn(ctx, fn, al))
+        return findings
+
+    def _check_fn(self, ctx: FileContext, fn, al) -> Iterable[Finding]:
+        if isinstance(fn, ast.Lambda):
+            return []
+        # positional params without defaults are presumed traced; defaulted and
+        # kw-only params are presumed static config (jit static args and
+        # closure-style hyperparameters are passed that way in this codebase)
+        args = fn.args
+        n_defaults = len(args.defaults)
+        positional = args.posonlyargs + args.args
+        undefaulted = positional[: len(positional) - n_defaults] if n_defaults else positional
+        traced = {a.arg for a in undefaulted if a.arg not in ("self", "cls")}
+        findings = []
+        fname = getattr(fn, "name", "<lambda>")
+
+        jnp_roots = set(al.jax)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name in ("jax.numpy", "jax.lax", "jax.nn") and a.asname:
+                        jnp_roots.add(a.asname)
+
+        def expr_traced(expr) -> bool:
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) and n.id in traced:
+                    return True
+                if isinstance(n, ast.Call):
+                    d = dotted(n.func)
+                    if d and d.split(".")[0] in jnp_roots:
+                        return True
+            return False
+
+        # one source-order pass: propagate tracedness through assignments,
+        # flag branches; nested defs are their own traced functions and are
+        # visited by check() directly, so skip their subtrees here
+        def visit(body):
+            for stmt in body:
+                if isinstance(stmt, _SCOPE_NODES):
+                    continue
+                if isinstance(stmt, ast.Assign) and expr_traced(stmt.value):
+                    for t in stmt.targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                traced.add(n.id)
+                if isinstance(stmt, (ast.If, ast.While)):
+                    finder = _TracedNameFinder(traced)
+                    finder.visit(stmt.test)
+                    if finder.hits:
+                        kind = "if" if isinstance(stmt, ast.If) else "while"
+                        names = ", ".join(sorted(finder.hits))
+                        findings.append(
+                            self.finding(
+                                ctx,
+                                stmt,
+                                f"Python `{kind}` on traced value(s) {names} inside "
+                                f"jit-traced {fname!r}; use lax.cond/lax.while_loop "
+                                f"or jnp.where",
+                            )
+                        )
+                for field_body in (
+                    getattr(stmt, "body", None),
+                    getattr(stmt, "orelse", None),
+                    getattr(stmt, "finalbody", None),
+                ):
+                    if isinstance(field_body, list):
+                        visit([s for s in field_body if isinstance(s, ast.stmt)])
+                for h in getattr(stmt, "handlers", []) or []:
+                    visit(h.body)
+
+        visit(fn.body)
+        return findings

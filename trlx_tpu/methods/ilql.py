@@ -76,7 +76,6 @@ class ILQLConfig(MethodConfig):
         else:
             actions = jnp.take_along_axis(batch.input_ids[:, 1:], batch.actions_ixs, axis=1)
         bsize, nactions = actions.shape
-        dsize = logits.shape[-1]
 
         Q = [jnp.take_along_axis(q, actions[..., None], axis=-1)[..., 0] for q in qs]
         targetQs = [

@@ -283,7 +283,6 @@ class T5LM(nn.Module):
             )
 
     def encode(self, input_ids: jnp.ndarray, attention_mask: Optional[jnp.ndarray] = None):
-        c = self.config
         B, S = input_ids.shape
         x = self.shared(input_ids)
         mask_bias = None
@@ -356,7 +355,6 @@ class T5LM(nn.Module):
     ):
         """Returns (logits, hidden, new_cache). With ``cache``, T may be 1 and
         ``positions`` gives absolute decoder positions for the relative bias."""
-        c = self.config
         B, T = decoder_input_ids.shape
         x = self.shared(decoder_input_ids)
 

@@ -865,7 +865,6 @@ class TransformerLM(nn.Module):
         if seq_shard:
             x = constrain_seq(x)
         captures = {}
-        branch_hidden = None
         if c.stacked:
             if capture_set:
                 raise NotImplementedError(

@@ -68,6 +68,10 @@ class AsyncRolloutEngine:
         self._pause_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        # guards the producer-written counters below; deliberately separate
+        # from _pause_lock so stats readers on the learner thread never wait
+        # out a full produce iteration
+        self._stats_lock = threading.Lock()
         self._busy_time = 0.0
         self._wall_start: Optional[float] = None
         self._produced = 0
@@ -96,8 +100,9 @@ class AsyncRolloutEngine:
                     version, params = self.publisher.latest()
                     t0 = time.monotonic()
                     elements = self._produce(params, version)
-                    self._busy_time += time.monotonic() - t0
-                    self._produced += len(elements)
+                    with self._stats_lock:
+                        self._busy_time += time.monotonic() - t0
+                        self._produced += len(elements)
                 tagged = [e.replace(policy_version=version) for e in elements]
                 # outside the pause lock: backpressure must not block evaluate().
                 # Bounded puts with heartbeats between retries: a *gated* queue
@@ -194,13 +199,17 @@ class AsyncRolloutEngine:
         if self._wall_start is None:
             return 0.0
         wall = max(time.monotonic() - self._wall_start, 1e-9)
-        return min(1.0, self._busy_time / wall)
+        with self._stats_lock:
+            busy = self._busy_time
+        return min(1.0, busy / wall)
 
     def summary(self) -> dict:
         q = self.queue.stats()
         s = self.accountant.stats()
+        with self._stats_lock:
+            produced = self._produced
         return {
-            "produced": self._produced,
+            "produced": produced,
             "consumed": q["total_got"],
             "dropped_stale": s["dropped_stale"],
             "peak_queue_depth": q["peak_depth"],
@@ -215,7 +224,9 @@ class AsyncRolloutEngine:
         gauges.set("rollout/queue_depth", float(q["depth"]))
         gauges.set("rollout/queue_peak_depth", float(q["peak_depth"]))
         gauges.set("rollout/queue_gated", q["gated"])
-        gauges.set("rollout/produced", float(self._produced))
+        with self._stats_lock:
+            produced = self._produced
+        gauges.set("rollout/produced", float(produced))
         gauges.set("rollout/dropped_stale", float(s["dropped_stale"]))
         gauges.set("rollout/staleness_mean", float(s["staleness_last_mean"]))
         gauges.set("rollout/staleness_max", float(s["staleness_max"]))
