@@ -72,7 +72,8 @@ def build_config() -> TRLConfig:
     return config
 
 
-def main(hparams={}):
+def main(hparams=None):
+    hparams = hparams if hparams is not None else {}
     config = TRLConfig.update(build_config().to_dict(), hparams)
     samples = load_data()
     eval_prompts = [p for p, _ in samples[:8]]

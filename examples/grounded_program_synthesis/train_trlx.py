@@ -47,7 +47,8 @@ def build_config() -> TRLConfig:
     return config
 
 
-def main(hparams={}):
+def main(hparams=None):
+    hparams = hparams if hparams is not None else {}
     config = TRLConfig.update(build_config().to_dict(), hparams)
     samples, rewards = generate_dataset(n=256)
     eval_prompts = [s.split("Function:")[0] + "Function:" for s in samples[:8]]

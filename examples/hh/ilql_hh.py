@@ -42,7 +42,8 @@ def build_config() -> TRLConfig:
     return config
 
 
-def main(hparams={}):
+def main(hparams=None):
+    hparams = hparams if hparams is not None else {}
     config = TRLConfig.update(build_config().to_dict(), hparams)
     # dialogue pairs with binary preference rewards (reference preprocess():
     # prompt_output = [[prompt, chosen], [prompt, rejected]], reward = [1, -1])

@@ -64,7 +64,8 @@ def build_config() -> TRLConfig:
     return config
 
 
-def main(hparams={}):
+def main(hparams=None):
+    hparams = hparams if hparams is not None else {}
     config = TRLConfig.update(build_config().to_dict(), hparams)
     chosen_by_prompt = dict(zip(PROMPTS, CHOSEN))
 

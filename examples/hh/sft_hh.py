@@ -132,7 +132,8 @@ def ensure_hh_base(base_dir: str = "ckpts/hh_base_r4", steps: int = 400,
     )
 
 
-def main(hparams={}):
+def main(hparams=None):
+    hparams = hparams if hparams is not None else {}
     config = TRLConfig.update(build_config().to_dict(), hparams)
     samples = [p + c for p, c in zip(PROMPTS, CHOSEN)] * 32
     trlx_tpu.train(

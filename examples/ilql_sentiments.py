@@ -46,7 +46,8 @@ def build_config() -> TRLConfig:
     return config
 
 
-def main(hparams={}):
+def main(hparams=None):
+    hparams = hparams if hparams is not None else {}
     config = TRLConfig.update(build_config().to_dict(), hparams)
     # offline stand-in for starting from pretrained gpt2 (the reference's base):
     # byte-level fluency takes far longer than the RL signal does

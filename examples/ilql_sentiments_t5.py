@@ -13,7 +13,8 @@ from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.default_configs import default_ilql_config
 
 
-def main(hparams={}):
+def main(hparams=None):
+    hparams = hparams if hparams is not None else {}
     config = default_ilql_config()
     config = config.evolve(
         train={

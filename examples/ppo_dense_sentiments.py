@@ -12,7 +12,8 @@ from examples.sentiment_task import PROMPT_STUBS, dense_lexicon_sentiment
 from trlx_tpu.data.configs import TRLConfig
 
 
-def main(hparams={}):
+def main(hparams=None):
+    hparams = hparams if hparams is not None else {}
     config = TRLConfig.update(build_config().to_dict(), hparams)
     config.train.checkpoint_dir = "ckpts/ppo_dense_sentiments"
 

@@ -55,7 +55,8 @@ def reward_fn(samples, outputs=None, **kwargs):
     return lexicon_sentiment(outputs if outputs is not None else samples)
 
 
-def main(hparams={}):
+def main(hparams=None):
+    hparams = hparams if hparams is not None else {}
     config = TRLConfig.update(build_config().to_dict(), hparams)
     if not hf_task_available():
         # offline stand-in for starting from gpt2-imdb: the tiny byte model

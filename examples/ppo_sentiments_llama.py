@@ -20,7 +20,8 @@ LLAMA_TINY = dict(
 )
 
 
-def main(hparams={}):
+def main(hparams=None):
+    hparams = hparams if hparams is not None else {}
     config = default_ppo_config()
     config = config.evolve(
         train={

@@ -12,7 +12,8 @@ from examples.sentiment_task import PROMPT_STUBS
 from trlx_tpu.data.configs import TRLConfig
 
 
-def main(hparams={}):
+def main(hparams=None):
+    hparams = hparams if hparams is not None else {}
     config = build_config()
     config.model.peft_config = {"peft_type": "LORA", "r": 8, "lora_alpha": 16,
                                 "target_modules": ["q_proj", "v_proj"]}

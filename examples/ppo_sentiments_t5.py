@@ -42,7 +42,8 @@ def build_config() -> TRLConfig:
     return config
 
 
-def main(hparams={}):
+def main(hparams=None):
+    hparams = hparams if hparams is not None else {}
     config = TRLConfig.update(build_config().to_dict(), hparams)
     if not os.path.isdir(os.environ.get("T5_MODEL", "google/flan-t5-small")):
         # offline stand-in for flan-t5: tiny T5 SFT'd on (stub -> continuation)

@@ -71,7 +71,8 @@ def build_config() -> TRLConfig:
     return config
 
 
-def main(hparams={}):
+def main(hparams=None):
+    hparams = hparams if hparams is not None else {}
     config = TRLConfig.update(build_config().to_dict(), hparams)
     trlx_tpu.train(
         reward_fn=reward_fn, prompts=PROMPTS * 4, eval_prompts=PROMPTS, config=config

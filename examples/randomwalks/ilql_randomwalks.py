@@ -32,7 +32,8 @@ def default_config(alphabet: str) -> TRLConfig:
     return config
 
 
-def main(hparams={}):
+def main(hparams=None):
+    hparams = hparams if hparams is not None else {}
     import numpy as np
 
     metric_fn, eval_prompts, walks, adjacency, alphabet = generate_random_walks(seed=1002)

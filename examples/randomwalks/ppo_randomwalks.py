@@ -96,7 +96,8 @@ def pretrain_on_walks(config: TRLConfig, sample_walks, out_dir: str, steps: int 
     return hf_dir
 
 
-def main(hparams={}):
+def main(hparams=None):
+    hparams = hparams if hparams is not None else {}
     metric_fn, prompts, *_rest, alphabet = generate_random_walks(seed=1002)
     _, _, sample_walks, _, _ = generate_random_walks(seed=1002)
     hparams = dict(hparams)

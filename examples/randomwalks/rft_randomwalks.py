@@ -29,7 +29,8 @@ def build_config(alphabet: str) -> TRLConfig:
     return TRLConfig.from_dict(d)
 
 
-def main(hparams={}):
+def main(hparams=None):
+    hparams = hparams if hparams is not None else {}
     metric_fn, prompts, sample_walks, _, alphabet = generate_random_walks(seed=1000)
     config = TRLConfig.update(build_config(alphabet).to_dict(), hparams)
     # same warm start as the reference (its CarperAI/randomwalks checkpoint is

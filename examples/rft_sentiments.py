@@ -31,7 +31,8 @@ def build_config() -> TRLConfig:
     return config
 
 
-def main(hparams={}):
+def main(hparams=None):
+    hparams = hparams if hparams is not None else {}
     config = TRLConfig.update(build_config().to_dict(), hparams)
     trlx_tpu.train(
         reward_fn=lambda samples, **kw: lexicon_sentiment(samples),

@@ -25,7 +25,8 @@ def build_config() -> TRLConfig:
     return config
 
 
-def main(hparams={}):
+def main(hparams=None):
+    hparams = hparams if hparams is not None else {}
     config = TRLConfig.update(build_config().to_dict(), hparams)
     corpus = build_corpus(512)
     positive = [s for s in corpus if lexicon_sentiment([s])[0] > 0]

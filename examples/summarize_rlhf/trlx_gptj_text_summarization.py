@@ -48,7 +48,8 @@ def make_dataset(n=400, seed=0):
     return rows
 
 
-def main(hparams={}, base_dir="ckpts/summarize", sft_steps=150, rm_steps=150):
+def main(hparams=None, base_dir="ckpts/summarize", sft_steps=150, rm_steps=150):
+    hparams = hparams if hparams is not None else {}
     rows = make_dataset()
 
     # ---- stage 1: SFT on (doc, good summary)

@@ -12,9 +12,12 @@ echo "== lint (scripts/lint.py)"
 python scripts/lint.py trlx_tpu examples tests scripts bench.py __graft_entry__.py
 
 echo "== graftcheck (python -m trlx_tpu.analysis)"
-# semantic gate: JAX RNG/tracing discipline + thread/lock discipline (docs/
-# static-analysis.md). Hard-fails on any finding that is neither noqa'd at
-# the line nor justified in graftcheck-baseline.txt
+# semantic gate: JAX RNG/tracing discipline, thread/lock discipline, and the
+# SPMD program checks — collective axis names, donation hazards, mixed
+# precision, PartitionSpec sanity (JX005-JX008, docs/static-analysis.md).
+# One invocation covers every registered rule over the repo-wide call graph;
+# hard-fails on any finding that is neither noqa'd at the line nor justified
+# in graftcheck-baseline.txt
 JAX_PLATFORMS=cpu python -m trlx_tpu.analysis trlx_tpu tests examples scripts bench.py __graft_entry__.py
 
 echo "== tests"

@@ -11,6 +11,10 @@ implements the highest-signal checks directly on the AST/token stream:
         ``with ... as name`` bindings; tuple unpacking, ``_``-prefixed names,
         augmented assignments, and loop/except targets are exempt, matching
         pyflakes' default latitude)
+  B006  mutable default argument (``def f(x=[])`` / ``={}`` / ``=set()`` and
+        the ``list()``/``dict()``/``set()`` call forms): the default is built
+        ONCE at def time and shared by every call — scope-aware like F841
+        (every def is checked, however deeply nested)
   E999  syntax error
   W291  trailing whitespace / W191 tab indentation
   E501  line too long (default 120, like the reference's setup.cfg)
@@ -152,6 +156,64 @@ class UnusedLocalVisitor(ast.NodeVisitor):
     visit_AsyncFunctionDef = visit_FunctionDef
 
 
+class MutableDefaultVisitor(ast.NodeVisitor):
+    """B006: defaults evaluated once at ``def`` time and shared across calls.
+
+    Flags display literals (``[]``, ``{}``, ``{1}``) and the bare constructor
+    calls ``list()``/``dict()``/``set()`` used as parameter defaults, in every
+    function scope (lambdas included). Non-empty constructor calls and other
+    expressions are left alone: pyflakes-style latitude for factories the
+    author plausibly intends to share (``=frozenset(...)``, module constants).
+    """
+
+    _CONSTRUCTORS = {"list", "dict", "set"}
+
+    def __init__(self):
+        self.findings = []  # (lineno, param name, description)
+
+    def _check_fn(self, node, name):
+        args = node.args
+        positional = args.posonlyargs + args.args
+        defaults = args.defaults
+        pairs = list(zip(positional[len(positional) - len(defaults):], defaults))
+        pairs += [
+            (a, d) for a, d in zip(args.kwonlyargs, args.kw_defaults) if d is not None
+        ]
+        for arg, default in pairs:
+            desc = self._mutable(default)
+            if desc is not None:
+                self.findings.append(
+                    (default.lineno, f"{name}({arg.arg}={desc})")
+                )
+
+    def _mutable(self, node):
+        if isinstance(node, ast.List):
+            return "[]"
+        if isinstance(node, ast.Dict):
+            return "{}"
+        if isinstance(node, ast.Set):
+            return "{...}"
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in self._CONSTRUCTORS
+            and not node.args
+            and not node.keywords
+        ):
+            return f"{node.func.id}()"
+        return None
+
+    def visit_FunctionDef(self, node):
+        self._check_fn(node, node.name)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._check_fn(node, "<lambda>")
+        self.generic_visit(node)
+
+
 def lint_file(path: Path):
     findings = []
     try:
@@ -210,6 +272,16 @@ def lint_file(path: Path):
         if lineno not in noqa:
             findings.append(
                 (path, lineno, "F841", f"local variable {name!r} is assigned to but never used")
+            )
+
+    # mutable default arguments
+    mv = MutableDefaultVisitor()
+    mv.visit(tree)
+    for lineno, desc in mv.findings:
+        if lineno not in noqa:
+            findings.append(
+                (path, lineno, "B006", f"mutable default argument in {desc}: "
+                 f"evaluated once at def time and shared across calls")
             )
     return findings
 

@@ -1,15 +1,34 @@
 #!/usr/bin/env bash
 # Fast local pre-commit: lint + graftcheck on CHANGED .py files only.
 #
-#   bash scripts/precommit.sh [BASE]
+#   bash scripts/precommit.sh [BASE] [--select RULES]
 #
 # BASE defaults to HEAD: staged + unstaged + untracked changes are checked.
 # Pass a ref (e.g. main) to check everything that differs from that ref.
+# --select RULES (comma-separated, e.g. --select JX005,JX008) is passed
+# through to graftcheck to run one rule family while iterating on a fix.
 # Full-tree equivalents run in scripts/ci.sh; this is the seconds-fast loop.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-BASE="${1:-HEAD}"
+BASE="HEAD"
+SELECT=""
+while [[ $# -gt 0 ]]; do
+    case "$1" in
+        --select)
+            SELECT="${2:?--select needs a comma-separated rule list}"
+            shift 2
+            ;;
+        --select=*)
+            SELECT="${1#--select=}"
+            shift
+            ;;
+        *)
+            BASE="$1"
+            shift
+            ;;
+    esac
+done
 
 # changed-or-added tracked files vs BASE, plus untracked ones; deletions drop
 # out via --diff-filter (a deleted file cannot be linted)
@@ -39,6 +58,8 @@ python scripts/lint.py "${files[@]}"
 echo "== graftcheck"
 # baseline keys are repo-root-relative (the same paths ci.sh uses), so the
 # committed baseline applies unchanged to a partial file list
-JAX_PLATFORMS=cpu python -m trlx_tpu.analysis "${files[@]}"
+select_args=()
+[[ -n "$SELECT" ]] && select_args=(--select "$SELECT")
+JAX_PLATFORMS=cpu python -m trlx_tpu.analysis "${files[@]}" "${select_args[@]}"
 
 echo "precommit OK"

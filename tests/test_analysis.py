@@ -33,11 +33,25 @@ def rule_ids(findings):
     return [f.rule for f in findings]
 
 
+def check_files(tmp_path, files, select=None):
+    """Write a multi-file fixture tree and run the full pipeline over the
+    directory, so the cross-module call graph is built exactly as in CI."""
+    for name, source in files.items():
+        f = tmp_path / name
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(textwrap.dedent(source))
+    return run([str(tmp_path)], select=select)
+
+
 # ----------------------------------------------------------------- registry
 
 
-def test_all_six_rules_registered():
-    assert {"JX001", "JX002", "JX003", "JX004", "TH001", "TH002"} <= set(RULES)
+def test_all_rules_registered():
+    assert {
+        "JX001", "JX002", "JX003", "JX004",
+        "JX005", "JX006", "JX007", "JX008",
+        "TH001", "TH002",
+    } <= set(RULES)
     for rule in RULES.values():
         assert rule.summary
 
@@ -668,7 +682,11 @@ def test_cli_select_and_unknown_rule(tmp_path):
 def test_cli_list_rules(capsys):
     assert cli_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rid in ("JX001", "JX002", "JX003", "JX004", "TH001", "TH002"):
+    for rid in (
+        "JX001", "JX002", "JX003", "JX004",
+        "JX005", "JX006", "JX007", "JX008",
+        "TH001", "TH002",
+    ):
         assert rid in out
 
 
@@ -755,3 +773,728 @@ def test_f841_noqa(tmp_path):
         """,
     )
     assert [f for f in findings if f[2] == "F841"] == []
+
+
+# ----------------------------------------------- cross-module call graph
+
+
+def test_callgraph_jit_wrap_of_imported_symbol_taints_definer(tmp_path):
+    findings = check_files(
+        tmp_path,
+        {
+            "helpers.py": """
+            def step(x):
+                return float(x) + 1.0
+            """,
+            "main.py": """
+            import jax
+            from helpers import step
+
+            fast_step = jax.jit(step)
+            """,
+        },
+        select=["JX002"],
+    )
+    assert rule_ids(findings) == ["JX002"]
+    assert findings[0].path.endswith("helpers.py")
+    assert "float(" in findings[0].message
+
+
+def test_callgraph_jit_wrap_of_module_attribute(tmp_path):
+    findings = check_files(
+        tmp_path,
+        {
+            "helpers.py": """
+            def step(x):
+                return x.item()
+            """,
+            "main.py": """
+            import jax
+            import helpers
+
+            fast_step = jax.jit(helpers.step)
+            """,
+        },
+        select=["JX002"],
+    )
+    assert rule_ids(findings) == ["JX002"]
+    assert findings[0].path.endswith("helpers.py")
+
+
+def test_callgraph_call_from_traced_body_taints_import(tmp_path):
+    findings = check_files(
+        tmp_path,
+        {
+            "helpers.py": """
+            def inner(x):
+                return x.item()
+            """,
+            "main.py": """
+            import jax
+            from helpers import inner
+
+            @jax.jit
+            def step(x):
+                return inner(x)
+            """,
+        },
+        select=["JX002"],
+    )
+    assert rule_ids(findings) == ["JX002"]
+    assert findings[0].path.endswith("helpers.py")
+
+
+def test_callgraph_two_hop_transitive_taint(tmp_path):
+    findings = check_files(
+        tmp_path,
+        {
+            "first.py": """
+            from second import deepest
+
+            def middle(x):
+                return deepest(x)
+            """,
+            "second.py": """
+            def deepest(x):
+                return x.item()
+            """,
+            "main.py": """
+            import jax
+            from first import middle
+
+            @jax.jit
+            def step(x):
+                return middle(x)
+            """,
+        },
+        select=["JX002"],
+    )
+    assert rule_ids(findings) == ["JX002"]
+    assert findings[0].path.endswith("second.py")
+
+
+def test_callgraph_no_taint_without_jit(tmp_path):
+    findings = check_files(
+        tmp_path,
+        {
+            "helpers.py": """
+            def step(x):
+                return float(x) + 1.0
+            """,
+            "main.py": """
+            from helpers import step
+
+            result = step(3)
+            """,
+        },
+        select=["JX002"],
+    )
+    assert findings == []
+
+
+def test_callgraph_relative_import_in_package(tmp_path):
+    findings = check_files(
+        tmp_path,
+        {
+            "pkg/__init__.py": "",
+            "pkg/helpers.py": """
+            def inner(x):
+                return x.item()
+            """,
+            "pkg/main.py": """
+            import jax
+            from .helpers import inner
+
+            @jax.jit
+            def step(x):
+                return inner(x)
+            """,
+        },
+        select=["JX002"],
+    )
+    assert rule_ids(findings) == ["JX002"]
+    assert findings[0].path.endswith("pkg/helpers.py")
+
+
+def test_callgraph_ambiguous_suffix_resolves_to_nothing(tmp_path):
+    # two scanned modules both answer to the suffix `helpers`: the importer's
+    # edge must drop (a missed edge loses a finding, a wrong edge invents one)
+    findings = check_files(
+        tmp_path,
+        {
+            "a/helpers.py": """
+            def inner(x):
+                return x.item()
+            """,
+            "b/helpers.py": """
+            def inner(x):
+                return x.item()
+            """,
+            "main.py": """
+            import jax
+            from helpers import inner
+
+            @jax.jit
+            def step(x):
+                return inner(x)
+            """,
+        },
+        select=["JX002"],
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------------------- JX005
+
+
+def test_jx005_hard_coded_known_axis(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def f(x):
+            return jax.lax.psum(x, "model")
+        """,
+        select=["JX005"],
+    )
+    assert rule_ids(findings) == ["JX005"]
+    assert "hard-coded" in findings[0].message
+    assert "MODEL_AXIS" in findings[0].message
+
+
+def test_jx005_unknown_axis(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def f(x):
+            return jax.lax.pmean(x, "tensor")
+        """,
+        select=["JX005"],
+    )
+    assert rule_ids(findings) == ["JX005"]
+    assert "unknown mesh axis" in findings[0].message
+
+
+def test_jx005_mesh_constant_is_clean(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+        from trlx_tpu.parallel.mesh import MODEL_AXIS
+
+        def f(x):
+            return jax.lax.psum(x, MODEL_AXIS)
+        """,
+        select=["JX005"],
+    )
+    assert findings == []
+
+
+def test_jx005_axis_name_kwarg_on_any_call(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def f(x):
+            return ring_attention(x, axis_name="model")
+        """,
+        select=["JX005"],
+    )
+    assert rule_ids(findings) == ["JX005"]
+    assert "ring_attention" in findings[0].message
+
+
+def test_jx005_parameter_default(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def attn(x, axis_name="model"):
+            return x
+        """,
+        select=["JX005"],
+    )
+    assert rule_ids(findings) == ["JX005"]
+    assert "default of attn" in findings[0].message
+
+
+def test_jx005_from_import_and_tuple_axes(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        from jax.lax import psum
+
+        def f(x):
+            return psum(x, ("data", "fsdp"))
+        """,
+        select=["JX005"],
+    )
+    assert rule_ids(findings) == ["JX005", "JX005"]
+
+
+def test_jx005_axis_index_positional(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def f():
+            return jax.lax.axis_index("data")
+        """,
+        select=["JX005"],
+    )
+    assert rule_ids(findings) == ["JX005"]
+
+
+def test_jx005_variable_axis_is_clean(tmp_path):
+    # a Name (not a literal) can be anything; no static claim is made
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def f(x, axis):
+            return jax.lax.psum(x, axis)
+        """,
+        select=["JX005"],
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------------------- JX006
+
+
+def test_jx006_read_after_donate(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def f(params, grads):
+            return params
+
+        step = jax.jit(f, donate_argnums=(0,))
+
+        def train(params, grads):
+            new_params = step(params, grads)
+            loss = params.mean()
+            return new_params, loss
+        """,
+        select=["JX006"],
+    )
+    assert rule_ids(findings) == ["JX006"]
+    assert "donated" in findings[0].message
+
+
+def test_jx006_rebind_is_clean(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def f(params, grads):
+            return params
+
+        step = jax.jit(f, donate_argnums=(0,))
+
+        def train(params, grads):
+            params = step(params, grads)
+            return params.mean()
+        """,
+        select=["JX006"],
+    )
+    assert findings == []
+
+
+def test_jx006_inline_jit_donation(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def f(p):
+            return p
+
+        def train(params):
+            out = jax.jit(f, donate_argnums=(0,))(params)
+            return params.sum()
+        """,
+        select=["JX006"],
+    )
+    assert rule_ids(findings) == ["JX006"]
+
+
+def test_jx006_cross_iteration_reuse_in_loop(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def f(params, batch):
+            return params
+
+        step = jax.jit(f, donate_argnums=(0,))
+
+        def train(params, batches):
+            for batch in batches:
+                out = step(params, batch)
+            return out
+        """,
+        select=["JX006"],
+    )
+    assert rule_ids(findings) == ["JX006"]
+
+
+def test_jx006_donate_argnames_maps_to_position(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def f(params, grads):
+            return params
+
+        step = jax.jit(f, donate_argnames=("params",))
+
+        def train(params, grads):
+            new = step(params, grads)
+            return params
+        """,
+        select=["JX006"],
+    )
+    assert rule_ids(findings) == ["JX006"]
+
+
+def test_jx006_non_donated_arg_is_clean(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def f(params, grads):
+            return params
+
+        step = jax.jit(f, donate_argnums=(0,))
+
+        def train(params, grads):
+            new = step(params, grads)
+            return new, grads
+        """,
+        select=["JX006"],
+    )
+    assert findings == []
+
+
+def test_jx006_decorated_partial_donation(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        from functools import partial
+
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, batch):
+            return state
+
+        def train(state, batch):
+            new = step(state, batch)
+            return state
+        """,
+        select=["JX006"],
+    )
+    assert rule_ids(findings) == ["JX006"]
+
+
+# ------------------------------------------------------------------- JX007
+
+
+def test_jx007_bf16_reduction_without_dtype(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def f(x):
+            y = x.astype(jnp.bfloat16)
+            return jnp.sum(y)
+        """,
+        select=["JX007"],
+    )
+    assert rule_ids(findings) == ["JX007"]
+    assert "accumulates" in findings[0].message
+
+
+def test_jx007_dtype_kwarg_is_clean(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def f(x):
+            y = x.astype(jnp.bfloat16)
+            return jnp.sum(y, dtype=jnp.float32)
+        """,
+        select=["JX007"],
+    )
+    assert findings == []
+
+
+def test_jx007_method_form_reduction(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def f(x):
+            y = x.astype(jnp.float16)
+            return y.mean()
+        """,
+        select=["JX007"],
+    )
+    assert rule_ids(findings) == ["JX007"]
+
+
+def test_jx007_inline_narrow_operand(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.sum(x.astype(jnp.bfloat16))
+        """,
+        select=["JX007"],
+    )
+    assert rule_ids(findings) == ["JX007"]
+
+
+def test_jx007_astype_round_trip(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def f(x):
+            return x.astype(jnp.bfloat16).astype(jnp.float32)
+        """,
+        select=["JX007"],
+    )
+    assert rule_ids(findings) == ["JX007"]
+    assert "round-trip" in findings[0].message
+
+
+def test_jx007_wide_reduction_is_clean(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def f(x):
+            y = x.astype(jnp.float32)
+            return jnp.sum(y)
+        """,
+        select=["JX007"],
+    )
+    assert findings == []
+
+
+def test_jx007_upcast_rebind_clears_narrowness(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def f(x):
+            y = x.astype(jnp.bfloat16)
+            y = y.astype(jnp.float32)
+            return jnp.sum(y)
+        """,
+        select=["JX007"],
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------------------- JX008
+
+
+def test_jx008_unknown_axis(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        from jax.sharding import PartitionSpec
+
+        SPEC = PartitionSpec("tensor", None)
+        """,
+        select=["JX008"],
+    )
+    assert rule_ids(findings) == ["JX008"]
+    assert "not in the mesh vocabulary" in findings[0].message
+
+
+def test_jx008_duplicate_axis(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        from jax.sharding import PartitionSpec
+
+        SPEC = PartitionSpec("model", "model")
+        """,
+        select=["JX008"],
+    )
+    assert rule_ids(findings) == ["JX008"]
+    assert "appears twice" in findings[0].message
+
+
+def test_jx008_duplicate_via_tuple_entry(tmp_path):
+    # ("fsdp", "model") on dim 0 then "model" again on dim 1
+    findings = check_snippet(
+        tmp_path,
+        """
+        from jax.sharding import PartitionSpec
+
+        SPEC = PartitionSpec(("fsdp", "model"), "model")
+        """,
+        select=["JX008"],
+    )
+    assert rule_ids(findings) == ["JX008"]
+    assert "appears twice" in findings[0].message
+
+
+def test_jx008_vocabulary_axes_are_clean(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        from jax.sharding import PartitionSpec
+
+        from trlx_tpu.parallel.mesh import FSDP_AXIS, MODEL_AXIS
+
+        A = PartitionSpec("data", None, "model")
+        B = PartitionSpec(FSDP_AXIS, MODEL_AXIS)
+        """,
+        select=["JX008"],
+    )
+    assert findings == []
+
+
+def test_jx008_local_alias_is_followed(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        from jax.sharding import PartitionSpec
+
+        P = PartitionSpec
+        SPEC = P("tensor")
+        """,
+        select=["JX008"],
+    )
+    assert rule_ids(findings) == ["JX008"]
+
+
+def test_jx008_rule_table_rank_drift(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        from jax.sharding import PartitionSpec
+
+        RULES = [
+            (r".*bias$", PartitionSpec("model", "fsdp")),
+        ]
+        """,
+        select=["JX008"],
+    )
+    assert rule_ids(findings) == ["JX008"]
+    assert "rank-1" in findings[0].message
+
+
+def test_jx008_layers_scan_rule_gets_extra_dim(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        from jax.sharding import PartitionSpec
+
+        RULES = [
+            (r".*layers_scan/.*kernel$", PartitionSpec("pipe", "fsdp", "model")),
+        ]
+        """,
+        select=["JX008"],
+    )
+    assert findings == []
+
+
+def test_jx008_sharding_constraint_over_rank(tmp_path):
+    findings = check_snippet(
+        tmp_path,
+        """
+        import jax
+        from jax.sharding import PartitionSpec
+
+        def f(x):
+            return jax.lax.with_sharding_constraint(
+                x, PartitionSpec(None, None, None, None)
+            )
+        """,
+        select=["JX008"],
+    )
+    assert rule_ids(findings) == ["JX008"]
+    assert "rank 4" in findings[0].message
+
+
+# -------------------------------------------------------------- lint B006
+
+
+def test_b006_flags_mutable_defaults(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        def a(x=[]):
+            return x
+
+        def b(y={}):
+            return y
+
+        def c(*, z=set()):
+            return z
+
+        def d(w=dict()):
+            return w
+
+        def outer():
+            def nested(q=[1, 2]):
+                return q
+            return nested
+
+        double = lambda items=[]: items
+        """,
+    )
+    b006 = [f for f in findings if f[2] == "B006"]
+    assert len(b006) == 6
+    assert "a(x=[])" in b006[0][3]
+    assert any("<lambda>" in f[3] for f in b006)
+
+
+def test_b006_immutable_and_factory_defaults_are_clean(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        def f(a=(), b=None, c=0, d="x", e=frozenset((1,)), g=dict(k=1)):
+            return a, b, c, d, e, g
+        """,
+    )
+    assert [f for f in findings if f[2] == "B006"] == []
+
+
+def test_b006_noqa(tmp_path):
+    findings = lint_snippet(
+        tmp_path,
+        """
+        def f(x=[]):  # noqa
+            return x
+        """,
+    )
+    assert [f for f in findings if f[2] == "B006"] == []

@@ -8,7 +8,7 @@ import jax.numpy as jnp
 
 from trlx_tpu.ops.attention import xla_attention
 from trlx_tpu.ops.ring_attention import ring_attention
-from trlx_tpu.parallel.mesh import make_mesh
+from trlx_tpu.parallel.mesh import MODEL_AXIS, make_mesh
 
 
 @pytest.mark.parametrize("causal", [True, False])
@@ -21,7 +21,7 @@ def test_ring_matches_full_attention(causal):
     v = jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32)
 
     out = jax.jit(
-        lambda q, k, v: ring_attention(q, k, v, mesh, axis_name="model", causal=causal)
+        lambda q, k, v: ring_attention(q, k, v, mesh, axis_name=MODEL_AXIS, causal=causal)
     )(q, k, v)
     ref = xla_attention(q, k, v, jnp.ones((B, S), jnp.int32), causal, 1.0 / np.sqrt(D))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-5)
@@ -184,7 +184,7 @@ def test_ring_gqa_native_heads():
 
     def ring_loss(q, k, v):
         out = ring_attention(
-            q, k, v, mesh, axis_name="model", causal=True, kv_valid=kv_valid
+            q, k, v, mesh, axis_name=MODEL_AXIS, causal=True, kv_valid=kv_valid
         )
         return (out.astype(jnp.float32) ** 2).sum(), out
 

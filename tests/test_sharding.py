@@ -177,3 +177,88 @@ def test_global_batch_statistics_match_unsharded(mesh8):
     with mesh8:
         sharded = jax.jit(lambda a, m: whiten(a, mask=m))(db["x"], db["m"])
     np.testing.assert_allclose(np.asarray(sharded), np.asarray(local), atol=1e-5)
+
+
+# ----------------------------------------------------- spec_for_path table
+
+
+def test_spec_for_path_first_match_wins():
+    # the stacked layers_scan rules sit ABOVE the generic per-layer kernel
+    # rules: a scanned q_proj kernel must take the rank-3 stacked spec, not
+    # the rank-2 generic one further down the table
+    rules = default_lm_rules()
+    assert spec_for_path("model/layers_scan/attn/q_proj/kernel", rules) == PartitionSpec(
+        "pipe", "fsdp", "model"
+    )
+    assert spec_for_path("model/layers_0/attn/q_proj/kernel", rules) == PartitionSpec(
+        "fsdp", "model"
+    )
+    # prepending a more specific rule overrides the table for matching paths
+    # only (the documented extension point)
+    custom = [(r".*special/kernel$", PartitionSpec(None, "model"))] + list(rules)
+    assert spec_for_path("model/special/kernel", custom) == PartitionSpec(None, "model")
+    assert spec_for_path("model/layers_0/attn/q_proj/kernel", custom) == PartitionSpec(
+        "fsdp", "model"
+    )
+
+
+def test_spec_for_path_golden_canonical_paths():
+    """Every canonical parameter family resolves to its published spec."""
+    rules = default_lm_rules()
+    golden = {
+        "model/layers_0/attn/q_proj/kernel": PartitionSpec("fsdp", "model"),
+        "model/layers_0/attn/k_proj/kernel": PartitionSpec("fsdp", "model"),
+        "model/layers_0/attn/v_proj/kernel": PartitionSpec("fsdp", "model"),
+        "model/layers_0/attn/o_proj/kernel": PartitionSpec("model", "fsdp"),
+        "model/layers_0/mlp/up_proj/kernel": PartitionSpec("fsdp", "model"),
+        "model/layers_0/mlp/gate_proj/kernel": PartitionSpec("fsdp", "model"),
+        "model/layers_0/mlp/down_proj/kernel": PartitionSpec("model", "fsdp"),
+        "model/embed_tokens/embedding": PartitionSpec("model", "fsdp"),
+        "model/embed_positions/embedding": PartitionSpec(None, "fsdp"),
+        "lm_head/kernel": PartitionSpec("fsdp", "model"),
+        "value_head/fc_in/kernel": PartitionSpec(None, "model"),
+        "value_head/fc_in/bias": PartitionSpec("model"),
+        "value_head/fc_out/kernel": PartitionSpec("model", None),
+        # scalars / norms fall through to the replicated catch-all
+        "model/layers_0/ln_1/scale": PartitionSpec(),
+        "model/ln_f/bias": PartitionSpec(),
+    }
+    for path, want in golden.items():
+        assert spec_for_path(path, rules) == want, path
+
+
+# ----------------------------------------------------------- _clip_spec
+
+
+def test_clip_spec_truncates_over_rank(mesh8):
+    from trlx_tpu.parallel.sharding import _clip_spec
+
+    # a rank-3 spec against a rank-1 param keeps only the leading entry
+    spec = PartitionSpec("fsdp", "model", None)
+    assert _clip_spec(spec, (8,), mesh8) == PartitionSpec("fsdp")
+
+
+def test_clip_spec_drops_axis_not_in_mesh():
+    from trlx_tpu.parallel.sharding import _clip_spec
+
+    devices = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = jax.sharding.Mesh(devices, ("data", "model"))
+    spec = PartitionSpec("fsdp", "model")
+    assert _clip_spec(spec, (8, 8), mesh) == PartitionSpec(None, "model")
+
+
+def test_clip_spec_drops_non_dividing_dim(mesh8):
+    from trlx_tpu.parallel.sharding import _clip_spec
+
+    # dim 0 (size 3) is not divisible by fsdp=2 -> replicated; dim 1 keeps model
+    spec = PartitionSpec("fsdp", "model")
+    assert _clip_spec(spec, (3, 8), mesh8) == PartitionSpec(None, "model")
+
+
+def test_clip_spec_tuple_entry_uses_product(mesh8):
+    from trlx_tpu.parallel.sharding import _clip_spec
+
+    # ("data", "fsdp") shards one dim over 2*2=4 devices: 8 divides, 6 doesn't
+    spec = PartitionSpec(("data", "fsdp"), None)
+    assert _clip_spec(spec, (8, 5), mesh8) == spec
+    assert _clip_spec(spec, (6, 5), mesh8) == PartitionSpec(None, None)

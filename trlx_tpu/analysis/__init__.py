@@ -13,9 +13,21 @@ JX002   host-device sync (.item(), float(), np.asarray, device_get,
 JX003   impure ops under jit (clock reads, print/logging, global or
         attribute mutation) — trace-time-only execution
 JX004   Python if/while branching on a traced array value
+JX005   collective axis_name that is not a mesh constant exported by
+        parallel/mesh.py (hard-coded or unknown axis strings)
+JX006   buffer read again after being donated via donate_argnums/
+        donate_argnames (XLA invalidated it)
+JX007   reduction over bf16/f16 without dtype=, or an astype
+        round-trip that narrows then widens
+JX008   PartitionSpec with unknown/duplicate axes, or a rank that
+        drifts from parallel/sharding.py's rule table
 TH001   lock-guarded attribute accessed without the lock elsewhere
 TH002   threading.Thread with neither daemon= nor a reachable join()
 ======  ==============================================================
+
+Tracedness (JX002-JX004) is resolved over a cross-module import-aware
+call graph (:mod:`trlx_tpu.analysis.callgraph`): jitting a function
+imported from another scanned file taints that file's defs too.
 
 Run: ``python -m trlx_tpu.analysis PATH...`` (exit 1 on new findings).
 Suppress per line with ``# graftcheck: noqa[RULE]``; grandfather with a
@@ -32,7 +44,7 @@ from trlx_tpu.analysis.core import (  # noqa: F401
     register,
     run,
 )
-from trlx_tpu.analysis import rules_jax, rules_threads  # noqa: F401
+from trlx_tpu.analysis import rules_jax, rules_spmd, rules_threads  # noqa: F401
 
 __all__ = [
     "Finding",

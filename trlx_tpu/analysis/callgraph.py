@@ -1,0 +1,257 @@
+"""Cross-module call graph: repo-wide traced-function discovery.
+
+:mod:`trlx_tpu.analysis.astutils` proves jit-tracedness from what ONE file can
+see — decorators, same-file ``jax.jit(f)`` wraps, same-file bare-name calls.
+That misses the dominant pattern in this repo: a trainer jits a ``step`` that
+calls loss/ops helpers imported from other modules (``mesh_trainer`` →
+``methods.ppo`` → ``utils.modeling``), so a host sync or impure op in the
+helper file was invisible to JX002/JX003.
+
+:class:`Project` closes that gap. It is built once per ``run()`` from every
+parsed :class:`~trlx_tpu.analysis.core.FileContext` and computes a fixpoint of
+traced functions across module boundaries:
+
+1. every per-file traced set from :func:`astutils.traced_functions` seeds it;
+2. ``jax.jit(imported_f)`` / ``jax.jit(mod.f)`` anywhere taints ``f``'s def in
+   its home module (the same "wrapped anywhere in the file" rule astutils
+   applies locally, extended over imports);
+3. a call from a traced body to an imported symbol (``helper(x)`` with
+   ``from ops.helpers import helper``) or module attribute (``helpers.f(x)``)
+   taints the callee's def, then the callee's own same-file closure re-runs —
+   iterated over a worklist until nothing changes.
+
+Import resolution is textual, not importlib: module names derive from file
+paths, and a ``from helpers import f`` resolves by exact dotted name first,
+then by unique *suffix* match (so both ``trlx_tpu/ops/foo.py`` scanned as
+``trlx_tpu.ops.foo`` and a bare tmp-dir fixture ``helpers.py`` resolve).
+Ambiguous suffixes resolve to nothing — a missed edge only loses a finding,
+a wrong edge invents one.
+"""
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from trlx_tpu.analysis import astutils
+from trlx_tpu.analysis.astutils import Aliases, collect_aliases, dotted
+
+
+def module_name_for(rel: str) -> str:
+    """Dotted module name for a scanned path: ``trlx_tpu/ops/a.py`` →
+    ``trlx_tpu.ops.a``; ``pkg/__init__.py`` → ``pkg``. Path separators become
+    dots; dots inside a component (tmp dirs like ``pytest-0.d``) become ``_``
+    so they cannot fake a package boundary."""
+    parts = [p for p in rel.split("/") if p]
+    if not parts:
+        return ""
+    last = parts[-1]
+    if last.endswith(".py"):
+        last = last[:-3]
+    if last == "__init__":
+        parts = parts[:-1]
+    else:
+        parts[-1] = last
+    return ".".join(p.replace(".", "_") for p in parts)
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed file plus everything edge-building needs about it."""
+
+    ctx: object  # FileContext (untyped to avoid a core<->callgraph import cycle)
+    name: str
+    aliases: Aliases
+    defs_by_name: Dict[str, List[ast.AST]] = field(default_factory=dict)
+    #: local name -> dotted module it is bound to (``import a.b as m``)
+    module_bindings: Dict[str, str] = field(default_factory=dict)
+    #: local name -> (module dotted name, symbol) (``from a.b import f as g``)
+    symbol_bindings: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+
+
+class Project:
+    """The cross-module traced-function fixpoint over one ``run()``'s files."""
+
+    def __init__(self, contexts):
+        self.modules: Dict[str, ModuleInfo] = {}
+        self._by_ctx: Dict[int, ModuleInfo] = {}
+        #: trailing-component index for suffix resolution: "a.b" -> {names}
+        self._suffixes: Dict[str, Set[str]] = {}
+        for ctx in contexts:
+            name = module_name_for(ctx.rel)
+            if not name or name in self.modules:
+                continue  # duplicate names cannot be told apart; skip edges
+            info = ModuleInfo(ctx=ctx, name=name, aliases=collect_aliases(ctx.tree))
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    info.defs_by_name.setdefault(node.name, []).append(node)
+            self.modules[name] = info
+            self._by_ctx[id(ctx)] = info
+            parts = name.split(".")
+            for i in range(len(parts)):
+                self._suffixes.setdefault(".".join(parts[i:]), set()).add(name)
+        for info in self.modules.values():
+            self._collect_imports(info)
+        self._traced: Dict[str, Set[ast.AST]] = {
+            name: astutils.traced_functions(info.ctx.tree, info.aliases)
+            for name, info in self.modules.items()
+        }
+        self._fixpoint()
+
+    # -- import resolution ---------------------------------------------------
+
+    def _resolve(self, target: str, importer: Optional[ModuleInfo] = None) -> Optional[str]:
+        """Dotted import target -> scanned module name, or None. Exact match
+        first; otherwise the unique module whose name ends with the target
+        (tmp-dir fixtures and partial scans make exact prefixes unknowable)."""
+        if target in self.modules:
+            return target
+        candidates = self._suffixes.get(target, set())
+        if len(candidates) == 1:
+            return next(iter(candidates))
+        return None
+
+    def _collect_imports(self, info: ModuleInfo) -> None:
+        pkg_parts = info.name.split(".")[:-1]
+        for node in ast.walk(info.ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mod = self._resolve(a.name)
+                    if mod is None:
+                        continue
+                    if a.asname:
+                        info.module_bindings[a.asname] = mod
+                    else:
+                        # `import a.b.c` binds `a`; attribute chains a.b.c.f
+                        # are matched against the full dotted path at use sites
+                        info.module_bindings[a.name.split(".")[0]] = a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    prefix = ".".join(base + ([node.module] if node.module else []))
+                else:
+                    prefix = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    bound = a.asname or a.name
+                    # `from pkg import sub` may bind a submodule...
+                    sub = self._resolve(f"{prefix}.{a.name}" if prefix else a.name)
+                    if sub is not None:
+                        info.module_bindings[bound] = sub
+                        continue
+                    # ...or a symbol defined in `prefix`
+                    mod = self._resolve(prefix) if prefix else None
+                    if mod is not None:
+                        info.symbol_bindings[bound] = (mod, a.name)
+
+    def _defs_for(self, info: ModuleInfo, func: ast.AST) -> List[Tuple[str, ast.AST]]:
+        """(module name, def node) targets a call/wrap expression may reach,
+        through this module's import bindings. Local defs are handled by the
+        per-file closure, not here."""
+        out: List[Tuple[str, ast.AST]] = []
+        if isinstance(func, ast.Name):
+            target = info.symbol_bindings.get(func.id)
+            if target is not None:
+                mod, sym = target
+                for d in self.modules[mod].defs_by_name.get(sym, []):
+                    out.append((mod, d))
+        elif isinstance(func, ast.Attribute):
+            d = dotted(func)
+            if d is None or "." not in d:
+                return out
+            base, attr = d.rsplit(".", 1)
+            mod = None
+            if base in info.module_bindings:
+                bound = info.module_bindings[base]
+                mod = bound if bound in self.modules else self._resolve(bound)
+            elif self._resolve(base) is not None and base.split(".")[0] in info.module_bindings:
+                mod = self._resolve(base)  # full dotted `a.b.c.f` after `import a.b.c`
+            if mod is not None:
+                for node in self.modules[mod].defs_by_name.get(attr, []):
+                    out.append((mod, node))
+        return out
+
+    # -- fixpoint ------------------------------------------------------------
+
+    def _local_closure(self, name: str) -> bool:
+        """Re-run astutils' same-file bare-name closure for one module;
+        True when the traced set grew."""
+        info = self.modules[name]
+        traced = self._traced[name]
+        grew = False
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(traced):
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                        for callee in info.defs_by_name.get(node.func.id, []):
+                            if callee not in traced:
+                                traced.add(callee)
+                                changed = grew = True
+        return grew
+
+    def _fixpoint(self) -> None:
+        # static edges: jit-wraps of imported callables, from anywhere in a file
+        for name, info in self.modules.items():
+            for node in ast.walk(info.ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = astutils._jit_call_target(node, info.aliases)
+                if target is None or isinstance(target, ast.Lambda):
+                    continue
+                for mod, d in self._defs_for(info, target):
+                    self._traced[mod].add(d)
+
+        worklist = list(self.modules)
+        while worklist:
+            name = worklist.pop()
+            info = self.modules[name]
+            self._local_closure(name)
+            touched: Set[str] = set()
+            # dynamic edges: calls out of traced bodies into imported symbols
+            for fn in list(self._traced[name]):
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    for mod, d in self._defs_for(info, node.func):
+                        if d not in self._traced[mod]:
+                            self._traced[mod].add(d)
+                            touched.add(mod)
+            for mod in touched:
+                self._local_closure(mod)
+                if mod not in worklist:
+                    worklist.append(mod)
+
+    # -- rule-facing API -----------------------------------------------------
+
+    def module_for(self, ctx) -> Optional[ModuleInfo]:
+        return self._by_ctx.get(id(ctx))
+
+    def traced_functions(self, ctx) -> Set[ast.AST]:
+        """Final traced set for one file (cross-module taint included);
+        falls back to the per-file answer for contexts outside the project."""
+        info = self._by_ctx.get(id(ctx))
+        if info is None:
+            return astutils.traced_functions(ctx.tree, collect_aliases(ctx.tree))
+        return self._traced[info.name]
+
+    def traced_roots(self, ctx) -> List[ast.AST]:
+        """Like :func:`astutils.traced_roots` over the project-wide set:
+        traced functions minus those nested inside another traced function."""
+        traced = self.traced_functions(ctx)
+        roots = []
+        for fn in traced:
+            nested = False
+            for other in traced:
+                if other is fn:
+                    continue
+                for node in ast.walk(other):
+                    if node is fn:
+                        nested = True
+                        break
+                if nested:
+                    break
+            if not nested:
+                roots.append(fn)
+        return sorted(roots, key=lambda n: getattr(n, "lineno", 0))

@@ -40,7 +40,7 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     # populate the registry for --list-rules before any file is scanned
-    from trlx_tpu.analysis import rules_jax, rules_threads  # noqa: F401
+    from trlx_tpu.analysis import rules_jax, rules_spmd, rules_threads  # noqa: F401
 
     if args.list_rules:
         for rid in sorted(RULES):

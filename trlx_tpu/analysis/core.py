@@ -9,7 +9,12 @@ deliberately CFG-lite: rules see one file's AST at a time (plus per-file alias
 and parent maps from :mod:`trlx_tpu.analysis.astutils`) and approximate
 control flow with source order — precise enough for the hazards that matter
 (key reuse, host syncs under jit, unlocked shared state), cheap enough to run
-on every commit.
+on every commit. One whole-program structure rides on top: ``run()`` parses
+every file first and builds a :class:`trlx_tpu.analysis.callgraph.Project`
+(cross-module import-aware call graph), attached to each
+:class:`FileContext` as ``ctx.project``, so tracedness rules see jit contexts
+across files — a trainer jitting a loss imported from ``methods/`` taints the
+loss's home file.
 
 Suppression layers, in order of preference:
 
@@ -102,6 +107,9 @@ class FileContext:
     tree: ast.Module
     lines: List[str] = field(default_factory=list)
     noqa: Dict[int, Set[str]] = field(default_factory=dict)
+    #: the run-wide callgraph.Project; None for single-file use (tests,
+    #: library callers) — rules must degrade to per-file reasoning then
+    project: Optional[object] = None
 
     def line(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
@@ -181,7 +189,8 @@ def run(paths: Sequence, select: Optional[Sequence[str]] = None) -> List[Finding
     standalone)."""
     # rules register on import; import here so `from analysis.core import run`
     # alone is enough to get the full registry
-    from trlx_tpu.analysis import rules_jax, rules_threads  # noqa: F401
+    from trlx_tpu.analysis import rules_jax, rules_spmd, rules_threads  # noqa: F401
+    from trlx_tpu.analysis.callgraph import Project
 
     rules: Optional[List[Rule]] = None
     if select is not None:
@@ -190,15 +199,20 @@ def run(paths: Sequence, select: Optional[Sequence[str]] = None) -> List[Finding
             raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
         rules = [RULES[r] for r in select]
     findings: List[Finding] = []
+    contexts: List[FileContext] = []
     for f in iter_py_files(paths):
         rel = f.as_posix()
         try:
-            ctx = load_context(f, rel)
+            contexts.append(load_context(f, rel))
         except (SyntaxError, UnicodeDecodeError) as e:
             lineno = getattr(e, "lineno", 0) or 0
             findings.append(
                 Finding(path=rel, lineno=lineno, rule="GC000", message=f"unparseable: {e}")
             )
-            continue
+    # two-phase: parse everything, then build the cross-module call graph so
+    # every rule sees jit taint that crosses file boundaries
+    project = Project(contexts)
+    for ctx in contexts:
+        ctx.project = project
         findings.extend(check_file(ctx, rules))
     return findings

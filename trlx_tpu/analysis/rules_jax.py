@@ -17,9 +17,14 @@ JX004  Python branching on a traced value — ``if``/``while`` on an array
        with static args); the fix is ``lax.cond``/``lax.while_loop`` or
        ``jnp.where``.
 
-All four rules key off :func:`trlx_tpu.analysis.astutils.traced_functions`
-except JX001, which applies everywhere keys flow (key reuse is just as wrong
-in host-side rollout orchestration as under jit).
+All four rules key off traced-function discovery except JX001, which applies
+everywhere keys flow (key reuse is just as wrong in host-side rollout
+orchestration as under jit). When ``ctx.project`` is set (the normal ``run()``
+path), tracedness comes from the cross-module call graph
+(:mod:`trlx_tpu.analysis.callgraph`) — a trainer jitting a loss imported from
+another file taints that file's defs; standalone ``check_file`` calls fall
+back to :func:`trlx_tpu.analysis.astutils.traced_functions` per-file
+reasoning.
 
 Flow model (CFG-lite, shared with the module docstring of ``core``):
 statements are processed in source order; ``if``/``else`` branches are
@@ -44,6 +49,29 @@ from trlx_tpu.analysis.astutils import (
 )
 
 _SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+def _project_traced_roots(ctx: FileContext, al) -> List[ast.AST]:
+    """Traced roots for one file: project-wide (cross-module) when available,
+    per-file otherwise."""
+    if ctx.project is not None:
+        return ctx.project.traced_roots(ctx)
+    return traced_roots(ctx.tree, al)
+
+
+def _project_traced_functions(ctx: FileContext, al) -> Set[ast.AST]:
+    if ctx.project is not None:
+        return ctx.project.traced_functions(ctx)
+    return traced_functions(ctx.tree, al)
+
+
+def _may_have_traced(ctx: FileContext, al) -> bool:
+    """Cheap pre-filter: without a project, a file that never mentions jax
+    cannot contain traced code; with one, taint can arrive from any importer,
+    so only the (cheap, cached) project answer is trustworthy."""
+    if ctx.project is not None:
+        return True
+    return bool(al.jax or al.jit)
 
 
 def _terminates(body: List[ast.stmt]) -> bool:
@@ -216,10 +244,10 @@ class JX002HostSync(Rule):
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         al = collect_aliases(ctx.tree)
-        if not (al.jax or al.jit):
+        if not _may_have_traced(ctx, al):
             return []
         findings: List[Finding] = []
-        for root in traced_roots(ctx.tree, al):
+        for root in _project_traced_roots(ctx, al):
             fname = getattr(root, "name", "<lambda>")
             for node in _walk_traced(root):
                 if not isinstance(node, ast.Call):
@@ -260,10 +288,10 @@ class JX003ImpureJit(Rule):
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         al = collect_aliases(ctx.tree)
-        if not (al.jax or al.jit):
+        if not _may_have_traced(ctx, al):
             return []
         findings: List[Finding] = []
-        for root in traced_roots(ctx.tree, al):
+        for root in _project_traced_roots(ctx, al):
             fname = getattr(root, "name", "<lambda>")
             for node in _walk_traced(root):
                 msg = None
@@ -348,10 +376,10 @@ class JX004TracerBranch(Rule):
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         al = collect_aliases(ctx.tree)
-        if not (al.jax or al.jit):
+        if not _may_have_traced(ctx, al):
             return []
         findings: List[Finding] = []
-        for fn in sorted(traced_functions(ctx.tree, al), key=lambda n: n.lineno):
+        for fn in sorted(_project_traced_functions(ctx, al), key=lambda n: n.lineno):
             findings.extend(self._check_fn(ctx, fn, al))
         return findings
 

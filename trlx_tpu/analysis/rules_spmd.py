@@ -1,0 +1,830 @@
+"""graftcheck SPMD rules: mesh-axis, donation, precision and PartitionSpec
+discipline — the program-correctness layer for the pjit/mesh architecture.
+
+JX005  collective axis-name validation — every ``psum``/``pmean``/
+       ``all_gather``/``ppermute``/``axis_index`` (and any ``axis_name=``
+       keyword or parameter default) must name a mesh axis via the constants
+       ``parallel/mesh.py`` exports (``DATA/FSDP/PIPE/MODEL_AXIS``). A
+       hard-coded ``"model"`` works until the mesh vocabulary changes; an
+       unknown axis fails only at trace time on real hardware.
+JX006  donation hazards — a buffer passed through a ``donate_argnums``/
+       ``donate_argnames`` position is invalidated by XLA; reading it again
+       host-side returns garbage (or a deleted-buffer error) only on TPU,
+       never in CPU tests.
+JX007  mixed-precision discipline — reductions over bf16/f16 operands
+       without an explicit ``dtype=`` accumulate in bf16 (7-bit mantissa:
+       a 4k-token loss sum is wrong in the 2nd digit), and
+       ``astype``-narrow-then-widen round-trips destroy precision silently.
+JX008  PartitionSpec sanity — axis names outside the mesh vocabulary,
+       the same axis used for two dims of one spec (illegal in GSPMD), and
+       specs whose rank drifts from the parameter-table shapes in
+       ``parallel/sharding.py``.
+
+The mesh-axis vocabulary is parsed *statically* out of
+``trlx_tpu/parallel/mesh.py`` (the ``*_AXIS = "..."`` constants), so the
+single source of truth stays the mesh module — adding an axis there
+automatically teaches both rules, with a hard-coded fallback only for broken
+checkouts.
+"""
+
+import ast
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from trlx_tpu.analysis.astutils import collect_aliases, dotted, is_jit_ref, iter_functions
+from trlx_tpu.analysis.core import FileContext, Finding, Rule, register
+
+# -- mesh-axis vocabulary ----------------------------------------------------
+
+#: last-resort vocabulary if parallel/mesh.py cannot be parsed (value -> constant)
+_FALLBACK_VOCAB = {
+    "data": "DATA_AXIS",
+    "fsdp": "FSDP_AXIS",
+    "pipe": "PIPE_AXIS",
+    "model": "MODEL_AXIS",
+}
+
+_vocab_cache: Optional[Dict[str, str]] = None
+
+
+def mesh_axis_vocabulary() -> Dict[str, str]:
+    """Axis value -> exporting constant name (``{"model": "MODEL_AXIS", ...}``),
+    parsed from the module-level ``*_AXIS = "literal"`` assignments of
+    ``trlx_tpu/parallel/mesh.py``."""
+    global _vocab_cache
+    if _vocab_cache is not None:
+        return _vocab_cache
+    vocab: Dict[str, str] = {}
+    mesh_py = Path(__file__).resolve().parents[1] / "parallel" / "mesh.py"
+    try:
+        tree = ast.parse(mesh_py.read_text())
+        for node in tree.body:
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            t = node.targets[0]
+            if (
+                isinstance(t, ast.Name)
+                and t.id.endswith("_AXIS")
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                vocab[node.value.value] = t.id
+    except (OSError, SyntaxError):
+        pass
+    _vocab_cache = vocab or dict(_FALLBACK_VOCAB)
+    return _vocab_cache
+
+
+def _axis_constants() -> Set[str]:
+    """The constant names (``MODEL_AXIS``...) — a spec built from these is the
+    sanctioned form."""
+    return set(mesh_axis_vocabulary().values())
+
+
+# -- JX005: collective axis names -------------------------------------------
+
+#: collective -> positional index of its axis-name argument in jax.lax
+_COLLECTIVE_AXIS_POS = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "all_gather": 1,
+    "ppermute": 1,
+    "pshuffle": 1,
+    "psum_scatter": 1,
+    "all_to_all": 1,
+    "axis_index": 0,
+    "axis_size": 0,
+}
+
+
+def _lax_bindings(tree: ast.Module) -> Dict[str, str]:
+    """Local name -> jax.lax function name for ``from jax.lax import psum``."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax.lax":
+            for a in node.names:
+                out[a.asname or a.name] = a.name
+    return out
+
+
+@register
+class JX005CollectiveAxis(Rule):
+    id = "JX005"
+    summary = "collective axis_name not a mesh constant from parallel/mesh.py"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        al = collect_aliases(ctx.tree)
+        lax = _lax_bindings(ctx.tree)
+        if not (al.jax or lax):
+            return []
+        vocab = mesh_axis_vocabulary()
+        findings: List[Finding] = []
+        checked: Set[int] = set()  # expr node ids, dedups kwarg-vs-collective
+
+        def flag(node: ast.AST, value: str, where: str) -> None:
+            if value in vocab:
+                msg = (
+                    f"hard-coded mesh axis {value!r} in {where}; use "
+                    f"{vocab[value]} from trlx_tpu.parallel.mesh"
+                )
+            else:
+                msg = (
+                    f"unknown mesh axis {value!r} in {where}: mesh vocabulary "
+                    f"is {sorted(vocab)} (trlx_tpu/parallel/mesh.py)"
+                )
+            findings.append(self.finding(ctx, node, msg))
+
+        def check_axis_expr(expr: Optional[ast.AST], where: str) -> None:
+            if expr is None or id(expr) in checked:
+                return
+            checked.add(id(expr))
+            if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+                flag(expr, expr.value, where)
+            elif isinstance(expr, (ast.Tuple, ast.List)):
+                for elt in expr.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                        flag(elt, elt.value, where)
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                cname = self._collective_name(node, al, lax)
+                if cname is not None:
+                    pos = _COLLECTIVE_AXIS_POS[cname]
+                    axis = node.args[pos] if len(node.args) > pos else None
+                    if axis is None:
+                        for kw in node.keywords:
+                            if kw.arg == "axis_name":
+                                axis = kw.value
+                    check_axis_expr(axis, f"lax.{cname}")
+                # any axis_name= keyword — custom collectives (ring attention,
+                # shard_map'ed ops) take the mesh axis the same way
+                for kw in node.keywords:
+                    if kw.arg == "axis_name":
+                        callee = dotted(node.func) or "<call>"
+                        check_axis_expr(kw.value, f"{callee}(axis_name=...)")
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for arg, default in self._arg_defaults(node):
+                    if arg == "axis_name":
+                        check_axis_expr(default, f"default of {node.name}({arg}=...)")
+        return findings
+
+    @staticmethod
+    def _collective_name(call: ast.Call, al, lax: Dict[str, str]) -> Optional[str]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            target = lax.get(fn.id)
+            return target if target in _COLLECTIVE_AXIS_POS else None
+        d = dotted(fn)
+        if d is None:
+            return None
+        parts = d.split(".")
+        if len(parts) >= 2 and parts[-2] == "lax" and parts[-1] in _COLLECTIVE_AXIS_POS:
+            return parts[-1]
+        return None
+
+    @staticmethod
+    def _arg_defaults(fn) -> Iterable[Tuple[str, ast.AST]]:
+        positional = fn.args.posonlyargs + fn.args.args
+        defaults = fn.args.defaults
+        for arg, default in zip(positional[len(positional) - len(defaults):], defaults):
+            yield arg.arg, default
+        for arg, default in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+            if default is not None:
+                yield arg.arg, default
+
+
+# -- JX006: donation hazards -------------------------------------------------
+
+
+def _donate_spec(call: ast.Call) -> Optional[Tuple[Set[int], Set[str]]]:
+    """(positions, names) donated by a ``jax.jit(...)`` call, or None when the
+    call donates nothing / the spec is not statically readable."""
+    positions: Set[int] = set()
+    names: Set[str] = set()
+    saw = False
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            saw = True
+            for v in _const_ints(kw.value):
+                positions.add(v)
+        elif kw.arg == "donate_argnames":
+            saw = True
+            for v in _const_strs(kw.value):
+                names.add(v)
+    if not saw or not (positions or names):
+        return None
+    return positions, names
+
+
+def _const_ints(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+def _const_strs(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+class _Donor:
+    """A callable known to donate: positions and/or parameter names."""
+
+    def __init__(self, positions: Set[int], names: Set[str], params: Optional[List[str]] = None):
+        self.positions = set(positions)
+        self.names = set(names)
+        if params:  # map argnames -> positions when the wrapped def is visible
+            for n in names:
+                if n in params:
+                    self.positions.add(params.index(n))
+
+
+class _DonationFlow:
+    """Source-order read-after-donate tracker for one scope (the flow model
+    JX001 uses: branches fork and merge by union, loop bodies run twice so a
+    donation surviving one iteration collides with its own read on the next)."""
+
+    def __init__(self, rule: "JX006DonationHazard", ctx: FileContext, donors: Dict[str, _Donor], al):
+        self.rule = rule
+        self.ctx = ctx
+        self.donors = donors
+        self.al = al
+        self.findings: List[Finding] = []
+        self._flagged: Set[int] = set()
+
+    _SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+    def run(self, body: List[ast.stmt]) -> None:
+        self._block(body, {})
+
+    # donated: name -> (donation lineno, donor label)
+    def _block(self, body, donated):
+        for stmt in body:
+            donated = self._stmt(stmt, donated)
+        return donated
+
+    def _stmt(self, stmt, donated):
+        if isinstance(stmt, self._SCOPE_NODES):
+            return donated
+        if isinstance(stmt, ast.If):
+            self._scan(stmt.test, donated)
+            after_body = self._block(stmt.body, dict(donated))
+            after_else = self._block(stmt.orelse, dict(donated))
+            merged = dict(after_body)
+            merged.update(after_else)
+            return merged
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            head = stmt.iter if isinstance(stmt, (ast.For, ast.AsyncFor)) else stmt.test
+            self._scan(head, donated)
+            donated = self._block(stmt.body, donated)
+            self._scan(head, donated)
+            donated = self._block(stmt.body, donated)  # cross-iteration reuse
+            return self._block(stmt.orelse, donated)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._scan(item.context_expr, donated)
+            return self._block(stmt.body, donated)
+        if isinstance(stmt, ast.Try):
+            donated = self._block(stmt.body, donated)
+            for h in stmt.handlers:
+                donated = self._block(h.body, dict(donated))
+            donated = self._block(stmt.orelse, donated)
+            return self._block(stmt.finalbody, donated)
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                name = dotted(t)
+                if name:
+                    donated.pop(name, None)
+            return donated
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = getattr(stmt, "value", None)
+            if value is not None:
+                self._scan(value, donated)
+                self._donations(value, donated)
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            for t in targets:
+                for name in self._target_names(t):
+                    donated.pop(name, None)  # rebinding re-arms the name
+            return donated
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._scan(child, donated)
+                self._donations(child, donated)
+        return donated
+
+    def _target_names(self, target):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                yield from self._target_names(elt)
+        else:
+            name = dotted(target)
+            if name:
+                yield name
+
+    def _scan(self, expr, donated):
+        """Flag loads of already-donated names inside ``expr``."""
+        if expr is None or not donated:
+            return
+        for node in ast.walk(expr):
+            if isinstance(node, self._SCOPE_NODES):
+                continue
+            name = None
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                name = node.id
+            elif isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load):
+                name = dotted(node)
+            if name in donated and id(node) not in self._flagged:
+                self._flagged.add(id(node))
+                lineno, donor = donated[name]
+                self.findings.append(
+                    self.rule.finding(
+                        self.ctx,
+                        node,
+                        f"{name!r} was donated to {donor} at line {lineno} "
+                        f"(buffer invalidated by XLA) and is read again here; "
+                        f"rebind the result or drop the donation",
+                    )
+                )
+
+    def _donations(self, expr, donated):
+        """Record names donated by calls inside ``expr``."""
+        for node in ast.walk(expr):
+            if isinstance(node, self._SCOPE_NODES) or not isinstance(node, ast.Call):
+                continue
+            donor = None
+            label = None
+            callee = dotted(node.func)
+            if callee is not None and callee in self.donors:
+                donor = self.donors[callee]
+                label = callee
+            elif isinstance(node.func, ast.Call):
+                # inline: jax.jit(f, donate_argnums=...)(params, opt_state)
+                spec = (
+                    _donate_spec(node.func) if is_jit_ref(node.func.func, self.al) else None
+                )
+                if spec is not None:
+                    donor = _Donor(*spec)
+                    inner = dotted(node.func.args[0]) if node.func.args else None
+                    label = f"jax.jit({inner or '...'})"
+            if donor is None:
+                continue
+            for i, arg in enumerate(node.args):
+                if i in donor.positions:
+                    name = dotted(arg)
+                    if name:
+                        donated[name] = (node.lineno, label)
+            for kw in node.keywords:
+                if kw.arg in donor.names:
+                    name = dotted(kw.value)
+                    if name:
+                        donated[name] = (node.lineno, label)
+
+
+@register
+class JX006DonationHazard(Rule):
+    id = "JX006"
+    summary = "buffer read again after being donated via donate_argnums/argnames"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        al = collect_aliases(ctx.tree)
+        if not (al.jax or al.jit):
+            return []
+        donors = self._collect_donors(ctx.tree, al)
+        has_inline = any(
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Call)
+            and is_jit_ref(n.func.func, al)
+            for n in ast.walk(ctx.tree)
+        )
+        if not donors and not has_inline:
+            return []
+        findings: List[Finding] = []
+        flow = _DonationFlow(self, ctx, donors, al)
+        flow.run(ctx.tree.body)
+        for fn in iter_functions(ctx.tree):
+            if not isinstance(fn, ast.Lambda):
+                flow.run(fn.body)
+        findings.extend(flow.findings)
+        return findings
+
+    def _collect_donors(self, tree: ast.Module, al) -> Dict[str, _Donor]:
+        """File-wide map of donating callables: ``g = jax.jit(f, donate_*)``
+        assignments (incl. ``self.attr`` targets) and ``@partial(jax.jit,
+        donate_*)``-decorated defs."""
+        defs_params: Dict[str, List[str]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_params[node.name] = [a.arg for a in node.args.posonlyargs + node.args.args]
+
+        donors: Dict[str, _Donor] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if not is_jit_ref(node.value.func, al):
+                    continue
+                spec = _donate_spec(node.value)
+                if spec is None:
+                    continue
+                wrapped = dotted(node.value.args[0]) if node.value.args else None
+                params = defs_params.get(wrapped) if wrapped else None
+                for t in node.targets:
+                    name = dotted(t)
+                    if name:
+                        donors[name] = _Donor(spec[0], spec[1], params)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                spec = self._decorator_donation(node, al)
+                if spec is not None:
+                    donors[node.name] = _Donor(spec[0], spec[1], defs_params.get(node.name))
+        return donors
+
+    @staticmethod
+    def _decorator_donation(fn, al) -> Optional[Tuple[Set[int], Set[str]]]:
+        for dec in fn.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            if is_jit_ref(dec.func, al):
+                spec = _donate_spec(dec)
+                if spec is not None:
+                    return spec
+            # @partial(jax.jit, donate_argnums=...)
+            fname = dotted(dec.func)
+            is_partial = (
+                isinstance(dec.func, ast.Name) and dec.func.id in al.partial
+            ) or (fname is not None and fname.endswith(".partial"))
+            if is_partial and dec.args and is_jit_ref(dec.args[0], al):
+                spec = _donate_spec(dec)
+                if spec is not None:
+                    return spec
+        return None
+
+
+# -- JX007: mixed-precision discipline ---------------------------------------
+
+_NARROW_DTYPES = {"bfloat16", "float16"}
+_WIDE_DTYPES = {"float32", "float64"}
+_REDUCERS = {"sum", "mean", "var", "std", "prod"}
+
+
+def _jnp_aliases(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.numpy" and a.asname:
+                    out.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "numpy":
+                        out.add(a.asname or a.name)
+    return out
+
+
+def _dtype_class(node: ast.AST) -> Optional[str]:
+    """'narrow' / 'wide' / None for a dtype expression (``jnp.bfloat16``,
+    ``"bfloat16"``, ``np.float32``...)."""
+    name = None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        name = node.value
+    else:
+        d = dotted(node)
+        if d is not None:
+            name = d.split(".")[-1]
+    if name in _NARROW_DTYPES:
+        return "narrow"
+    if name in _WIDE_DTYPES:
+        return "wide"
+    return None
+
+
+def _astype_class(call: ast.Call) -> Optional[str]:
+    """dtype class of an ``x.astype(...)`` call, else None."""
+    if (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr == "astype"
+        and call.args
+    ):
+        return _dtype_class(call.args[0])
+    return None
+
+
+def _narrows(expr: ast.AST) -> bool:
+    """True when ``expr`` provably produces a narrow-dtype array: contains an
+    ``astype(bf16/f16)`` or a constructor with ``dtype=<narrow>``."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        if _astype_class(node) == "narrow":
+            return True
+        for kw in node.keywords:
+            if kw.arg == "dtype" and _dtype_class(kw.value) == "narrow":
+                return True
+    return False
+
+
+@register
+class JX007MixedPrecision(Rule):
+    id = "JX007"
+    summary = "reduction over bf16/f16 without dtype=, or a narrowing astype round-trip"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        jnp = _jnp_aliases(ctx.tree)
+        al = collect_aliases(ctx.tree)
+        if not (jnp or al.jax):
+            return []
+        findings: List[Finding] = []
+        self._roundtrips(ctx, findings)
+        self._scope(ctx, ctx.tree.body, jnp, findings)
+        for fn in iter_functions(ctx.tree):
+            if not isinstance(fn, ast.Lambda):
+                self._scope(ctx, fn.body, jnp, findings)
+        return findings
+
+    def _roundtrips(self, ctx: FileContext, findings: List[Finding]) -> None:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or _astype_class(node) != "wide":
+                continue
+            recv = node.func.value
+            if isinstance(recv, ast.Call) and _astype_class(recv) == "narrow":
+                findings.append(
+                    self.finding(
+                        ctx,
+                        node,
+                        "astype round-trip narrows then widens: the narrow cast "
+                        "already destroyed the mantissa; drop one of the casts",
+                    )
+                )
+
+    def _scope(self, ctx: FileContext, body: List[ast.stmt], jnp: Set[str], findings) -> None:
+        """Source-order pass: track names assigned from narrowing expressions,
+        flag dtype-less reductions over them (or over inline narrow casts)."""
+        narrow: Set[str] = set()
+        _SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+        def reduced_operand(call: ast.Call) -> Optional[ast.AST]:
+            """The array operand when ``call`` is a dtype-less reduction."""
+            if any(kw.arg == "dtype" for kw in call.keywords):
+                return None
+            fn = call.func
+            if not isinstance(fn, ast.Attribute) or fn.attr not in _REDUCERS:
+                return None
+            base = dotted(fn.value)
+            if base is not None and base in jnp:  # jnp.sum(x, ...)
+                return call.args[0] if call.args else None
+            if base is not None and base.split(".")[-1] == "numpy":
+                return call.args[0] if call.args else None
+            return fn.value  # x.sum() method form
+
+        def is_narrow(expr: Optional[ast.AST]) -> bool:
+            if expr is None:
+                return False
+            if isinstance(expr, ast.Name) and expr.id in narrow:
+                return True
+            d = dotted(expr)
+            if d is not None and d in narrow:
+                return True
+            return _narrows(expr)
+
+        def check_expr(expr: ast.AST) -> None:
+            for node in ast.walk(expr):
+                if isinstance(node, _SCOPES) or not isinstance(node, ast.Call):
+                    continue
+                operand = reduced_operand(node)
+                if operand is not None and is_narrow(operand):
+                    findings.append(
+                        self.finding(
+                            ctx,
+                            node,
+                            "reduction over a bf16/f16 operand accumulates in the "
+                            "narrow dtype; pass dtype=jnp.float32 or upcast first",
+                        )
+                    )
+
+        def visit(stmts: List[ast.stmt]) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, _SCOPES):
+                    continue
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                    value = getattr(stmt, "value", None)
+                    if value is not None:
+                        check_expr(value)
+                        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                        for t in targets:
+                            name = dotted(t)
+                            if name is None:
+                                continue
+                            if _narrows(value):
+                                narrow.add(name)
+                            else:
+                                narrow.discard(name)
+                    continue
+                for child in ast.iter_child_nodes(stmt):
+                    if isinstance(child, ast.expr):
+                        check_expr(child)
+                for block in (
+                    getattr(stmt, "body", None),
+                    getattr(stmt, "orelse", None),
+                    getattr(stmt, "finalbody", None),
+                ):
+                    if isinstance(block, list):
+                        visit([s for s in block if isinstance(s, ast.stmt)])
+                for h in getattr(stmt, "handlers", []) or []:
+                    visit(h.body)
+
+        visit(body)
+
+
+# -- JX008: PartitionSpec sanity ---------------------------------------------
+
+_table_rank_cache: Optional[int] = None
+
+
+def _table_max_rank() -> int:
+    """Max positional rank among the PartitionSpec literals in
+    ``parallel/sharding.py``'s rule tables — statically parsed so the table
+    stays the single source of truth; falls back to 3 (the stacked-layer
+    kernel rank) on broken checkouts."""
+    global _table_rank_cache
+    if _table_rank_cache is not None:
+        return _table_rank_cache
+    max_rank = 0
+    sharding_py = Path(__file__).resolve().parents[1] / "parallel" / "sharding.py"
+    try:
+        tree = ast.parse(sharding_py.read_text())
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Tuple)
+                and len(node.elts) == 2
+                and isinstance(node.elts[0], ast.Constant)
+                and isinstance(node.elts[0].value, str)
+                and isinstance(node.elts[1], ast.Call)
+            ):
+                callee = dotted(node.elts[1].func) or ""
+                if callee.split(".")[-1] in ("PartitionSpec", "P"):
+                    max_rank = max(max_rank, len(node.elts[1].args))
+    except (OSError, SyntaxError):
+        pass
+    _table_rank_cache = max_rank or 3
+    return _table_rank_cache
+
+
+def _pspec_names(tree: ast.Module) -> Set[str]:
+    """Names bound to ``jax.sharding.PartitionSpec`` in this file, including
+    local re-aliases (``P = PartitionSpec``)."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module in ("jax.sharding", "jax.interpreters.pxla"):
+                for a in node.names:
+                    if a.name == "PartitionSpec":
+                        names.add(a.asname or a.name)
+    changed = True
+    while changed:  # chase P = PartitionSpec; PS = P
+        changed = False
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in names
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id not in names:
+                        names.add(t.id)
+                        changed = True
+    return names
+
+
+@register
+class JX008PartitionSpecSanity(Rule):
+    id = "JX008"
+    summary = "PartitionSpec with unknown/duplicate axes or rank off the sharding table"
+
+    #: expected rank for a rule-table pattern, by path suffix; ``layers_scan``
+    #: rules carry one extra leading (stacked-layer) dim
+    _SUFFIX_RANK = {"kernel$": 2, "embedding$": 2, "bias$": 1, "scale$": 1}
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        pspec = _pspec_names(ctx.tree)
+        if not pspec and "PartitionSpec" not in ctx.source:
+            return []
+        vocab = mesh_axis_vocabulary()
+        constants = _axis_constants()
+        findings: List[Finding] = []
+
+        def is_pspec_call(node: ast.AST) -> bool:
+            if not isinstance(node, ast.Call):
+                return False
+            if isinstance(node.func, ast.Name):
+                return node.func.id in pspec
+            d = dotted(node.func)
+            return d is not None and d.endswith("sharding.PartitionSpec")
+
+        def entry_axes(arg: ast.AST) -> List[Tuple[ast.AST, Optional[str]]]:
+            """(node, axis value or None-if-unresolvable) for one spec entry;
+            a tuple entry (several mesh axes on one dim) contributes several."""
+            elts = arg.elts if isinstance(arg, (ast.Tuple, ast.List)) else [arg]
+            out: List[Tuple[ast.AST, Optional[str]]] = []
+            for e in elts:
+                if isinstance(e, ast.Constant):
+                    out.append((e, e.value if isinstance(e.value, str) else None))
+                else:
+                    d = dotted(e)
+                    last = d.split(".")[-1] if d else None
+                    if last in constants:  # MODEL_AXIS et al. resolve to values
+                        value = next(v for v, c in vocab.items() if c == last)
+                        out.append((e, value))
+                    else:
+                        out.append((e, None))
+            return out
+
+        for node in ast.walk(ctx.tree):
+            if not is_pspec_call(node):
+                continue
+            seen: Dict[str, int] = {}
+            for arg in node.args:
+                if isinstance(arg, ast.Starred):
+                    continue  # P(*entries): dynamic, nothing provable
+                for axis_node, value in entry_axes(arg):
+                    if value is None:
+                        continue
+                    if value not in vocab:
+                        findings.append(
+                            self.finding(
+                                ctx,
+                                axis_node,
+                                f"PartitionSpec axis {value!r} is not in the mesh "
+                                f"vocabulary {sorted(vocab)} (trlx_tpu/parallel/mesh.py)",
+                            )
+                        )
+                        continue
+                    if value in seen:
+                        findings.append(
+                            self.finding(
+                                ctx,
+                                axis_node,
+                                f"mesh axis {value!r} appears twice in one "
+                                f"PartitionSpec (first at line {seen[value]}): an "
+                                f"axis may shard at most one dim",
+                            )
+                        )
+                    else:
+                        seen[value] = axis_node.lineno
+
+        findings.extend(self._rank_checks(ctx, is_pspec_call))
+        return findings
+
+    def _rank_checks(self, ctx: FileContext, is_pspec_call) -> List[Finding]:
+        findings: List[Finding] = []
+        max_rank = _table_max_rank()
+        for node in ast.walk(ctx.tree):
+            # rule-table tuples: ("path regex", PartitionSpec(...))
+            if (
+                isinstance(node, ast.Tuple)
+                and len(node.elts) == 2
+                and isinstance(node.elts[0], ast.Constant)
+                and isinstance(node.elts[0].value, str)
+                and is_pspec_call(node.elts[1])
+            ):
+                pattern = node.elts[0].value
+                expected = None
+                for suffix, rank in self._SUFFIX_RANK.items():
+                    if pattern.endswith(suffix):
+                        expected = rank + (1 if "layers_scan" in pattern else 0)
+                        break
+                rank = len(node.elts[1].args)
+                if expected is not None and rank > expected:
+                    findings.append(
+                        self.finding(
+                            ctx,
+                            node.elts[1],
+                            f"sharding rule {pattern!r} names a rank-{expected} "
+                            f"parameter but its PartitionSpec has {rank} entries",
+                        )
+                    )
+            # with_sharding_constraint with a literal over-rank spec
+            elif isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d is None or not d.endswith("with_sharding_constraint"):
+                    continue
+                if len(node.args) >= 2 and is_pspec_call(node.args[1]):
+                    spec = node.args[1]
+                    if any(isinstance(a, ast.Starred) for a in spec.args):
+                        continue
+                    rank = len(spec.args)
+                    if rank > max_rank:
+                        findings.append(
+                            self.finding(
+                                ctx,
+                                spec,
+                                f"with_sharding_constraint spec has rank {rank}, "
+                                f"above every rule in parallel/sharding.py's table "
+                                f"(max rank {max_rank})",
+                            )
+                        )
+        return findings

@@ -65,7 +65,10 @@ class ILQLConfig(MethodConfig):
         """
         logits, (qs, target_qs, vs) = outputs
         terminal_mask = batch.dones[:, :-1].astype(vs.dtype)
-        n_nonterminal = jnp.maximum(terminal_mask.sum(), 1.0)
+        # loss sums pin dtype=float32: Q/V are f32 by head design but the CE
+        # term multiplies in logits-derived terms that are bf16 on TPU
+        # (JX007 discipline)
+        n_nonterminal = jnp.maximum(terminal_mask.sum(dtype=jnp.float32), 1.0)
 
         # token ids actually taken at each action position (parity with the
         # reference's ILQLBatch-vs-seq2seq dispatch, modeling_ilql.py:99-103):
@@ -88,21 +91,21 @@ class ILQLConfig(MethodConfig):
         Vnext = vs[:, 1:, 0] * batch.dones[:, 1:].astype(vs.dtype)
         Q_ = batch.rewards + self.gamma * jax.lax.stop_gradient(Vnext)
 
-        loss_q = sum(jnp.sum(((Qi - Q_) * terminal_mask) ** 2) / n_nonterminal for Qi in Q)
+        loss_q = sum(jnp.sum(((Qi - Q_) * terminal_mask) ** 2, dtype=jnp.float32) / n_nonterminal for Qi in Q)
 
         expectile_w = jnp.where(targetQ >= V, self.tau, 1.0 - self.tau)
-        loss_v = jnp.sum(expectile_w * (targetQ - V) ** 2 * terminal_mask) / n_nonterminal
+        loss_v = jnp.sum(expectile_w * (targetQ - V) ** 2 * terminal_mask, dtype=jnp.float32) / n_nonterminal
 
         def cql_loss(q):
             logprobs = jax.nn.log_softmax(q, axis=-1)
             nll = -jnp.take_along_axis(logprobs, actions[..., None], axis=-1)[..., 0]
-            return jnp.sum(nll * terminal_mask) / n_nonterminal
+            return jnp.sum(nll * terminal_mask, dtype=jnp.float32) / n_nonterminal
 
         loss_cql = sum(cql_loss(q) for q in qs)
 
         ce = -jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1), actions[..., None], axis=-1)[..., 0]
         awac_weight = jax.lax.stop_gradient(jnp.exp(self.beta * (targetQ - V)))
-        loss_awac = jnp.sum(ce * awac_weight * terminal_mask) / n_nonterminal
+        loss_awac = jnp.sum(ce * awac_weight * terminal_mask, dtype=jnp.float32) / n_nonterminal
 
         loss = loss_q + loss_v + self.cql_scale * loss_cql + self.awac_scale * loss_awac
 

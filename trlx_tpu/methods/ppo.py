@@ -165,20 +165,23 @@ class PPOConfig(MethodConfig):
         exactly 1.0 at staleness 0, keeping on-policy losses bitwise-identical
         to the vanilla path."""
         mask = mask.astype(values.dtype)
-        n = jnp.maximum(mask.sum(), 1.0)
+        # every loss accumulation pins dtype=float32: operands may be bf16 on
+        # TPU, and a sequence-length sum in bf16 loses the low bits of exactly
+        # the small per-token terms PPO clips on (JX007 discipline)
+        n = jnp.maximum(mask.sum(dtype=jnp.float32), 1.0)
 
         values_clipped = jnp.clip(
             values, old_values - self.cliprange_value, old_values + self.cliprange_value
         )
         vf_loss1 = (values - returns) ** 2
         vf_loss2 = (values_clipped - returns) ** 2
-        vf_loss = 0.5 * jnp.sum(jnp.maximum(vf_loss1, vf_loss2) * mask) / n
-        vf_clipfrac = jnp.sum((vf_loss2 > vf_loss1).astype(mask.dtype) * mask) / n
+        vf_loss = 0.5 * jnp.sum(jnp.maximum(vf_loss1, vf_loss2) * mask, dtype=jnp.float32) / n
+        vf_clipfrac = jnp.sum((vf_loss2 > vf_loss1).astype(mask.dtype) * mask, dtype=jnp.float32) / n
 
         log_ratio = (logprobs - old_logprobs) * mask
         ratio = jnp.exp(log_ratio)
         # k3 estimator of approximate KL: mean(exp(-lr) - 1 + lr)
-        approx_kl = jnp.sum((jnp.exp(-log_ratio) - 1.0 + log_ratio) * mask) / n
+        approx_kl = jnp.sum((jnp.exp(-log_ratio) - 1.0 + log_ratio) * mask, dtype=jnp.float32) / n
 
         is_weights = None
         if staleness is not None and is_ratio_clip is not None:
@@ -192,8 +195,8 @@ class PPOConfig(MethodConfig):
 
         pg_loss1 = -advantages * ratio
         pg_loss2 = -advantages * jnp.clip(ratio, 1.0 - self.cliprange, 1.0 + self.cliprange)
-        pg_loss = jnp.sum(jnp.maximum(pg_loss1, pg_loss2) * mask) / n
-        pg_clipfrac = jnp.sum((pg_loss2 > pg_loss1).astype(mask.dtype) * mask) / n
+        pg_loss = jnp.sum(jnp.maximum(pg_loss1, pg_loss2) * mask, dtype=jnp.float32) / n
+        pg_clipfrac = jnp.sum((pg_loss2 > pg_loss1).astype(mask.dtype) * mask, dtype=jnp.float32) / n
 
         loss = pg_loss + self.vf_coef * vf_loss
 
@@ -206,7 +209,7 @@ class PPOConfig(MethodConfig):
                     max=jnp.max(jnp.where(mask > 0, values, -jnp.inf)),
                     std=jnp.sqrt(masked_mean((values - masked_mean(values, mask)) ** 2, mask)),
                 ),
-                values_error=jnp.sum(((values - returns) * mask) ** 2) / n,
+                values_error=jnp.sum(((values - returns) * mask) ** 2, dtype=jnp.float32) / n,
                 clipfrac=vf_clipfrac,
             ),
             old_values=dict(mean=masked_mean(old_values, mask)),
@@ -215,13 +218,13 @@ class PPOConfig(MethodConfig):
                 std=jnp.sqrt(masked_mean((returns - masked_mean(returns, mask)) ** 2, mask)),
             ),
             policy=dict(approx_kl=approx_kl, clipfrac=pg_clipfrac),
-            ratio=jnp.sum(ratio * mask) / n,
+            ratio=jnp.sum(ratio * mask, dtype=jnp.float32) / n,
             padding_percentage=1.0 - n / mask.size,
         )
         if is_weights is not None:
             stats["staleness"] = dict(
                 mean=jnp.mean(staleness.astype(jnp.float32)),
                 max=jnp.max(staleness),
-                is_weight_mean=jnp.sum(is_weights * mask) / n,
+                is_weight_mean=jnp.sum(is_weights * mask, dtype=jnp.float32) / n,
             )
         return loss, stats

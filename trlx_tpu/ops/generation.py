@@ -76,7 +76,9 @@ def generate(
     prompt_lens = attention_mask.sum(axis=1).astype(jnp.int32)
 
     cache = init_cache_fn(B, total)
-    if isinstance(cache, dict) and "index" in cache:
+    # pytree structure is static under trace — `in` probes dict keys, never
+    # array values
+    if isinstance(cache, dict) and "index" in cache:  # graftcheck: noqa[JX004]
         # static Python 0: marks prefill-from-zero at TRACE time, so the model's
         # prefill-only paths (flash kernel, prompt-tuning prepend) engage even
         # when this whole function is wrapped in an outer jit (where a

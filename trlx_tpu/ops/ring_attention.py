@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from trlx_tpu.parallel.mesh import MODEL_AXIS
+
 NEG_INF = -1e30
 
 
@@ -214,7 +216,7 @@ def ring_attention(
     k: jnp.ndarray,
     v: jnp.ndarray,
     mesh: Mesh,
-    axis_name: str = "model",
+    axis_name: str = MODEL_AXIS,
     causal: bool = True,
     scale: Optional[float] = None,
     kv_valid: Optional[jnp.ndarray] = None,

@@ -26,9 +26,12 @@ def logprobs_of_labels(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
 
     Shapes: logits [..., T, V], labels [..., T] -> [..., T].
     Parity: reference utils/modeling.py:213-218 (which shifts externally; callers here
-    pass already-aligned slices).
+    pass already-aligned slices). Logits arrive in the model's compute dtype (bf16 on
+    TPU); the logsumexp inside log_softmax must not accumulate a 32k-vocab sum in a
+    7-bit mantissa, so upcast first — KL penalties are differences of these logprobs
+    and bf16 rounding there directly biases the reward.
     """
-    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     return jnp.take_along_axis(logprobs, labels[..., None], axis=-1)[..., 0]
 
 
@@ -72,10 +75,12 @@ def get_global_statistics(
         mean = jnp.mean(xs)
         var = jnp.var(xs)
         return mean, var, count
-    s = jax.lax.psum(jnp.array([xs.sum(), xs.size], dtype=jnp.float32), axis_name)
+    # accumulate in f32 regardless of xs.dtype: a bf16 sum over a shard is
+    # already wrong before the psum ever sees it (JX007 discipline)
+    s = jax.lax.psum(jnp.array([xs.sum(dtype=jnp.float32), xs.size], dtype=jnp.float32), axis_name)
     global_sum, count = s[0], s[1]
     mean = global_sum / count
-    sum_var = jax.lax.psum(((xs - mean) ** 2).sum(), axis_name)
+    sum_var = jax.lax.psum(((xs - mean) ** 2).sum(dtype=jnp.float32), axis_name)
     return mean, sum_var / count, count
 
 
