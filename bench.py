@@ -365,6 +365,39 @@ def _attn_mem_probe(jax):
     return out
 
 
+def _ir_audit_probe():
+    """Per-step collective census + compiled memory of the registered hot
+    entrypoints, in exactly the graftcheck-ir budget's shape
+    (``<kind>:<mesh-axes>`` -> count/bytes, plus ``memory_bytes``) so a bench
+    artifact is directly diffable against ``graftcheck-ir-budget.json``. Runs
+    the deviceless auditor in a child process — it forces its own virtual-CPU
+    platform, so this works identically from the TPU and CPU bench paths."""
+    import subprocess
+    import tempfile
+
+    out_path = os.path.join(tempfile.gettempdir(), f"trlx_ir_bench_{os.getpid()}.json")
+    cmd = [sys.executable, "-m", "trlx_tpu.analysis.ir", "--no-baseline", "--json", out_path]
+    try:
+        # rc deliberately ignored: the probe records the measured profile even
+        # when it deviates from the committed budget (that is CI's job to fail)
+        subprocess.run(cmd, cwd=REPO_ROOT, timeout=900, capture_output=True)
+        with open(out_path) as f:
+            measurements = json.load(f)["measurements"]
+    except Exception as e:
+        return {"ir_audit_error": f"{type(e).__name__}: {e}"[:300]}
+    finally:
+        try:
+            os.remove(out_path)
+        except OSError:
+            pass
+    out = {}
+    for key, m in sorted(measurements.items()):
+        name = key.split("@")[0]
+        out[f"ir_{name}_collectives"] = m["collectives"]
+        out[f"ir_{name}_memory_bytes"] = m["memory_bytes"]
+    return out
+
+
 def measure():
     """Run the measurement on whatever platform the environment provides."""
     import jax
@@ -442,6 +475,7 @@ def measure():
         result.update(_gpt2_perf(jax))
     except Exception as e:  # never lose the primary metric to the extra one
         result["gpt2_perf_error"] = f"{type(e).__name__}: {e}"
+    result.update(_ir_audit_probe())
     if platform != "cpu":
         try:
             result.update(_big_perf(jax))

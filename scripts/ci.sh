@@ -53,6 +53,22 @@ echo "== analysis tests (CPU)"
 JAX_PLATFORMS=cpu timeout -k 10 300 \
     python -m pytest tests/test_analysis.py -q -m "not slow" -p no:cacheprovider
 
+echo "== analysis-ir tests (CPU)"
+# graftcheck-ir's own suite: entrypoint registry, IR001-IR004 on tiny inline
+# fns, budget round-trip/compare; the heavy full-model lowering tests are
+# slow-marked and run only in --slow rounds
+JAX_PLATFORMS=cpu timeout -k 10 300 \
+    python -m pytest tests/test_analysis_ir.py -q -m "not slow" -p no:cacheprovider
+
+echo "== graftcheck-ir budget gate (python -m trlx_tpu.analysis.ir)"
+# the IR-level gate: AOT-lowers every registered hot step devicelessly and
+# hard-fails when the compiled HLO's collective census or memory accounting
+# deviates from graftcheck-ir-budget.json, or a new IR001-IR004 finding
+# appears. An INTENDED profile change is committed by regenerating the budget:
+#   python -m trlx_tpu.analysis.ir --write-budget   # then commit the diff
+# (TRLX_COMPILE_CACHE makes repeat runs cheap.)
+timeout -k 10 900 python -m trlx_tpu.analysis.ir
+
 echo "== resilience tests (CPU)"
 # checkpoint atomicity, preemption, auto-resume, retry, chaos; the budget is
 # wider than the other suites because the preemption/resume contract is proven

@@ -644,6 +644,89 @@ def test_baseline_justification_comment_is_stripped(tmp_path):
     assert baseline_mod.parse_line(line) == "pkg/mod.py:JX001:b = jax.random.uniform(key, (2,))"
 
 
+def test_baseline_prune_round_trip(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        textwrap.dedent(
+            """
+            import jax
+
+            def f(key):
+                a = jax.random.normal(key, (2,))
+                b = jax.random.uniform(key, (2,))
+                return a + b
+            """
+        )
+    )
+    findings = run([str(src)])
+    assert len(findings) == 1
+
+    base_file = tmp_path / "baseline.txt"
+    live = f"{findings[0].key()}  # hand-written justification"
+    stale = "pkg/gone.py:JX001:b = jax.random.uniform(key, (9,))  # fixed ages ago"
+    base_file.write_text(f"# header comment stays\n\n{live}\n{stale}\n")
+
+    kept, removed = baseline_mod.prune(base_file, findings)
+    assert kept == 1
+    assert removed == [baseline_mod.parse_line(stale)]
+    text = base_file.read_text()
+    # comments, blanks, and the kept entry's justification survive verbatim
+    assert "# header comment stays" in text
+    assert live in text
+    assert "gone.py" not in text
+
+    # round-trip: the pruned baseline still exactly covers the findings
+    new, stale_keys = baseline_mod.compare(findings, baseline_mod.load(base_file))
+    assert new == [] and stale_keys == []
+    # idempotent: a second prune removes nothing and leaves the file alone
+    before = base_file.read_text()
+    kept, removed = baseline_mod.prune(base_file, findings)
+    assert (kept, removed) == (1, [])
+    assert base_file.read_text() == before
+
+
+def test_baseline_prune_respects_multiset_counts(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        textwrap.dedent(
+            """
+            import jax
+
+            def f(key):
+                a = jax.random.normal(key, (2,))
+                b = jax.random.uniform(key, (2,))
+                return a + b
+            """
+        )
+    )
+    findings = run([str(src)])
+    assert len(findings) == 1
+    key = findings[0].key()
+    base_file = tmp_path / "baseline.txt"
+    base_file.write_text(f"{key}  # first copy\n{key}  # duplicate copy\n")
+    kept, removed = baseline_mod.prune(base_file, findings)
+    # one finding consumes one entry; the later duplicate is the stale one
+    assert (kept, removed) == (1, [key])
+    assert base_file.read_text() == f"{key}  # first copy\n"
+
+
+def test_cli_prune_baseline(tmp_path, capsys):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import jax\n\ndef f(k):\n    a = jax.random.normal(k, (2,))\n"
+        "    return a + jax.random.gumbel(k, (2,))\n"
+    )
+    base = tmp_path / "base.txt"
+    assert cli_main([str(src), "--baseline", str(base), "--write-baseline"]) == 0
+    base.write_text(base.read_text() + "pkg/gone.py:JX001:x = 1  # stale\n")
+    assert cli_main([str(src), "--baseline", str(base), "--prune-baseline"]) == 0
+    out = capsys.readouterr().out
+    assert "1 pruned" in out and "pkg/gone.py" in out
+    assert cli_main([str(src), "--baseline", str(base)]) == 0
+    out = capsys.readouterr().out
+    assert "0 stale" in out
+
+
 # ---------------------------------------------------------------------- CLI
 
 
@@ -931,6 +1014,66 @@ def test_callgraph_ambiguous_suffix_resolves_to_nothing(tmp_path):
                 return x.item()
             """,
             "main.py": """
+            import jax
+            from helpers import inner
+
+            @jax.jit
+            def step(x):
+                return inner(x)
+            """,
+        },
+        select=["JX002"],
+    )
+    assert findings == []
+
+
+def test_callgraph_ambiguous_suffix_prefers_importer_package(tmp_path):
+    # same two `helpers` candidates, but the importer lives in package `a`:
+    # package-relative resolution picks a/helpers.py, so the edge (and the
+    # finding) comes back
+    findings = check_files(
+        tmp_path,
+        {
+            "a/__init__.py": "",
+            "a/helpers.py": """
+            def inner(x):
+                return x.item()
+            """,
+            "b/helpers.py": """
+            def inner(x):
+                return x.item()
+            """,
+            "a/main.py": """
+            import jax
+            from helpers import inner
+
+            @jax.jit
+            def step(x):
+                return inner(x)
+            """,
+        },
+        select=["JX002"],
+    )
+    assert rule_ids(findings) == ["JX002"]
+    assert findings[0].path.endswith("a/helpers.py")
+
+
+def test_callgraph_ambiguous_suffix_outside_every_package_still_drops(tmp_path):
+    # importer in package `c` holds NEITHER candidate: walking out of c finds
+    # both at once, so the edge must still drop rather than guess
+    findings = check_files(
+        tmp_path,
+        {
+            "a/helpers.py": """
+            def inner(x):
+                return x.item()
+            """,
+            "b/helpers.py": """
+            def inner(x):
+                return x.item()
+            """,
+            "c/__init__.py": "",
+            "c/main.py": """
             import jax
             from helpers import inner
 

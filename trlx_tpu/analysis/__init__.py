@@ -23,11 +23,23 @@ JX008   PartitionSpec with unknown/duplicate axes, or a rank that
         drifts from parallel/sharding.py's rule table
 TH001   lock-guarded attribute accessed without the lock elsewhere
 TH002   threading.Thread with neither daemon= nor a reachable join()
+IR001   f32/f64 heavy op inside a bf16-declared compiled step
+IR002   declared donation the compiled module does not alias (or a
+        donat-able input never declared)
+IR003   large trace-time constant baked into the compiled graph
+IR004   host round-trip (callback/infeed/outfeed) in a hot step
+IR005   per-step collective census deviates from the committed budget
+IR006   compiled memory accounting deviates from the committed budget
 ======  ==============================================================
 
 Tracedness (JX002-JX004) is resolved over a cross-module import-aware
 call graph (:mod:`trlx_tpu.analysis.callgraph`): jitting a function
 imported from another scanned file taints that file's defs too.
+
+``IR0xx`` rules live below the AST: :mod:`trlx_tpu.analysis.ir`
+AOT-lowers the registered hot entrypoints devicelessly and audits the
+jaxpr/compiled HLO (``python -m trlx_tpu.analysis.ir``, gated against
+``graftcheck-ir-budget.json``).
 
 Run: ``python -m trlx_tpu.analysis PATH...`` (exit 1 on new findings).
 Suppress per line with ``# graftcheck: noqa[RULE]``; grandfather with a
@@ -45,6 +57,7 @@ from trlx_tpu.analysis.core import (  # noqa: F401
     run,
 )
 from trlx_tpu.analysis import rules_jax, rules_spmd, rules_threads  # noqa: F401
+from trlx_tpu.analysis.ir import rules_ir  # noqa: F401  (registers IR001-IR006)
 
 __all__ = [
     "Finding",

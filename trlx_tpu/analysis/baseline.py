@@ -70,6 +70,39 @@ def compare(findings: Iterable[Finding], baseline: Counter) -> Tuple[List[Findin
     return new, stale
 
 
+def prune(path, findings: Iterable[Finding]) -> Tuple[int, List[str]]:
+    """Rewrite the baseline keeping only entries that still fire.
+
+    Returns ``(kept, removed_keys)``. Comments, blank lines, and each kept
+    entry's justification are preserved verbatim — only stale entries are
+    dropped, so a hand-curated baseline survives the prune. Entries are
+    consumed as a multiset in file order, mirroring :func:`compare`: if three
+    identical findings fire and the file holds four copies, the last copy is
+    the stale one. Missing file is a no-op."""
+    p = Path(path)
+    if not p.exists():
+        return 0, []
+    available = Counter(f.key() for f in findings)
+    kept_lines: List[str] = []
+    removed: List[str] = []
+    kept = 0
+    for raw in p.read_text().splitlines():
+        stripped = raw.strip()
+        if not stripped or stripped.startswith("#"):
+            kept_lines.append(raw)
+            continue
+        key = parse_line(stripped)
+        if available[key] > 0:
+            available[key] -= 1
+            kept_lines.append(raw)
+            kept += 1
+        else:
+            removed.append(key)
+    if removed:
+        p.write_text("\n".join(kept_lines) + "\n")
+    return kept, removed
+
+
 def write(path, findings: Iterable[Finding]) -> int:
     """Write a fresh baseline for ``findings`` (used by ``--write-baseline``).
     Every entry gets a TODO justification the author must replace."""

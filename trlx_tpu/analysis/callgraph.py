@@ -24,8 +24,10 @@ Import resolution is textual, not importlib: module names derive from file
 paths, and a ``from helpers import f`` resolves by exact dotted name first,
 then by unique *suffix* match (so both ``trlx_tpu/ops/foo.py`` scanned as
 ``trlx_tpu.ops.foo`` and a bare tmp-dir fixture ``helpers.py`` resolve).
-Ambiguous suffixes resolve to nothing — a missed edge only loses a finding,
-a wrong edge invents one.
+An ambiguous suffix is disambiguated package-relatively from the importing
+module (its own package's ``helpers`` beats a same-named module elsewhere);
+what remains ambiguous resolves to nothing — a missed edge only loses a
+finding, a wrong edge invents one.
 """
 
 import ast
@@ -102,12 +104,28 @@ class Project:
     def _resolve(self, target: str, importer: Optional[ModuleInfo] = None) -> Optional[str]:
         """Dotted import target -> scanned module name, or None. Exact match
         first; otherwise the unique module whose name ends with the target
-        (tmp-dir fixtures and partial scans make exact prefixes unknowable)."""
+        (tmp-dir fixtures and partial scans make exact prefixes unknowable).
+        An ambiguous suffix is disambiguated package-relatively: walking out
+        from ``importer``'s package, the first enclosing package holding
+        exactly ONE candidate wins (``from helpers import f`` inside
+        ``pkg.ops.foo`` picks ``pkg.ops.helpers`` over ``tests.helpers``).
+        Still-ambiguous targets resolve to nothing — a missed edge only
+        loses a finding, a wrong edge invents one."""
         if target in self.modules:
             return target
         candidates = self._suffixes.get(target, set())
         if len(candidates) == 1:
             return next(iter(candidates))
+        if len(candidates) > 1 and importer is not None:
+            parts = importer.name.split(".")[:-1]
+            while parts:
+                prefix = ".".join(parts) + "."
+                in_pkg = [c for c in candidates if c.startswith(prefix)]
+                if len(in_pkg) == 1:
+                    return in_pkg[0]
+                if in_pkg:
+                    return None  # several candidates in the SAME package
+                parts.pop()
         return None
 
     def _collect_imports(self, info: ModuleInfo) -> None:
@@ -115,7 +133,7 @@ class Project:
         for node in ast.walk(info.ctx.tree):
             if isinstance(node, ast.Import):
                 for a in node.names:
-                    mod = self._resolve(a.name)
+                    mod = self._resolve(a.name, info)
                     if mod is None:
                         continue
                     if a.asname:
@@ -135,12 +153,12 @@ class Project:
                         continue
                     bound = a.asname or a.name
                     # `from pkg import sub` may bind a submodule...
-                    sub = self._resolve(f"{prefix}.{a.name}" if prefix else a.name)
+                    sub = self._resolve(f"{prefix}.{a.name}" if prefix else a.name, info)
                     if sub is not None:
                         info.module_bindings[bound] = sub
                         continue
                     # ...or a symbol defined in `prefix`
-                    mod = self._resolve(prefix) if prefix else None
+                    mod = self._resolve(prefix, info) if prefix else None
                     if mod is not None:
                         info.symbol_bindings[bound] = (mod, a.name)
 
@@ -163,9 +181,9 @@ class Project:
             mod = None
             if base in info.module_bindings:
                 bound = info.module_bindings[base]
-                mod = bound if bound in self.modules else self._resolve(bound)
-            elif self._resolve(base) is not None and base.split(".")[0] in info.module_bindings:
-                mod = self._resolve(base)  # full dotted `a.b.c.f` after `import a.b.c`
+                mod = bound if bound in self.modules else self._resolve(bound, info)
+            elif self._resolve(base, info) is not None and base.split(".")[0] in info.module_bindings:
+                mod = self._resolve(base, info)  # full dotted `a.b.c.f` after `import a.b.c`
             if mod is not None:
                 for node in self.modules[mod].defs_by_name.get(attr, []):
                     out.append((mod, node))

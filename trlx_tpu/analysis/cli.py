@@ -10,6 +10,8 @@ Options:
     --no-baseline        ignore the baseline (report every finding as new)
     --write-baseline     rewrite the baseline from the current findings and
                          exit 0 (each entry gets a TODO justification)
+    --prune-baseline     drop stale baseline entries (keeping comments and
+                         justifications verbatim) and exit 0
     --select R1,R2       run only the listed rule ids
     --list-rules         print the rule registry and exit
 
@@ -35,6 +37,7 @@ def main(argv=None) -> int:
     parser.add_argument("--baseline", default=DEFAULT_BASELINE)
     parser.add_argument("--no-baseline", action="store_true")
     parser.add_argument("--write-baseline", action="store_true")
+    parser.add_argument("--prune-baseline", action="store_true")
     parser.add_argument("--select", default=None, help="comma-separated rule ids")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
@@ -59,6 +62,16 @@ def main(argv=None) -> int:
     if args.write_baseline:
         n = baseline_mod.write(args.baseline, findings)
         print(f"graftcheck: wrote {n} baseline entries to {args.baseline}")
+        return 0
+
+    if args.prune_baseline:
+        kept, removed = baseline_mod.prune(args.baseline, findings)
+        for key in removed:
+            print(f"graftcheck: pruned stale baseline entry: {key}")
+        print(
+            f"graftcheck: baseline {args.baseline}: {kept} entr"
+            f"{'y' if kept == 1 else 'ies'} kept, {len(removed)} pruned"
+        )
         return 0
 
     base = baseline_mod.load("/dev/null" if args.no_baseline else args.baseline)

@@ -1,0 +1,50 @@
+"""graftcheck-ir: jaxpr/HLO-level audit of the compiled hot steps.
+
+The AST rules (``JX0xx``/``TH0xx``) see source; this subpackage sees what XLA
+actually compiled. Hot entrypoints (the PPO train step, the decode/sampling
+step, the ILQL step) register themselves via
+:func:`trlx_tpu.analysis.ir.entrypoints.register_entrypoint`; the auditor
+AOT-lowers each one devicelessly (``jax.eval_shape`` param trees +
+``jit(...).lower()`` over ``ShapeDtypeStruct``s on a virtual CPU mesh — the
+same blueprint as ``scripts/scale_proof.py``), then walks the jaxpr and the
+compiled HLO:
+
+======  ==============================================================
+IR001   f32/f64 *heavy* ops (dot/conv) inside a declared-bf16 step,
+        beyond the entrypoint's allow-listed f32 accumulators
+IR002   donation effectiveness: declared donations the compiled module
+        does not alias; donat-able inputs never declared
+IR003   large trace-time constants baked into the graph
+IR004   host round-trips (callbacks / infeed / outfeed) in a hot step
+IR005   per-step collective audit (count + bytes per mesh axis) vs the
+        committed budget
+IR006   compiled peak-memory accounting vs the committed budget
+======  ==============================================================
+
+IR001–IR004 produce :class:`~trlx_tpu.analysis.core.Finding`s anchored at the
+entrypoint's registration site, flowing through the ordinary noqa/baseline
+machinery. IR005–IR006 are *budget* rules: measurements are compared against
+the committed ``graftcheck-ir-budget.json`` and deviations always fail —
+``--write-budget`` is the (reviewed, committed) escape hatch, not noqa.
+
+Run: ``python -m trlx_tpu.analysis.ir`` (deviceless; forces a virtual
+CPU platform before importing jax). Exit 1 on new findings or any budget
+deviation — the contract the ``analysis-ir`` section of ``scripts/ci.sh``
+gates on.
+"""
+
+from trlx_tpu.analysis.ir.entrypoints import (  # noqa: F401
+    ENTRYPOINTS,
+    EntryArtifacts,
+    EntryPoint,
+    load_all,
+    register_entrypoint,
+)
+
+__all__ = [
+    "ENTRYPOINTS",
+    "EntryArtifacts",
+    "EntryPoint",
+    "load_all",
+    "register_entrypoint",
+]

@@ -1,0 +1,46 @@
+"""Deviceless entry: force a virtual CPU platform, then run the audit.
+
+The device count must be pinned BEFORE jax initializes a backend — both the
+``XLA_FLAGS`` route (fresh process) and the config/clear_backends route
+(jax already imported, e.g. under a sitecustomize that pre-pins a TPU) are
+applied, the same recipe as ``tests/conftest.py`` / ``__graft_entry__``.
+"""
+
+import os
+import sys
+
+
+def _force_cpu(n_devices: int):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        )
+
+    import jax
+
+    try:
+        import jax.extend.backend
+
+        jax.extend.backend.clear_backends()
+    except Exception:
+        pass
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        pass  # pre-0.5 jax: XLA_FLAGS above covers it
+    devs = jax.devices()
+    if devs[0].platform != "cpu" or len(devs) < n_devices:
+        raise SystemExit(
+            f"graftcheck-ir: needs >= {n_devices} cpu devices, got "
+            f"{len(devs)} x {devs[0].platform} (was jax imported before -m?)"
+        )
+
+
+if __name__ == "__main__":
+    _force_cpu(int(os.environ.get("TRLX_IR_DEVICES", "8")))
+    from trlx_tpu.analysis.ir.cli import main
+
+    sys.exit(main())

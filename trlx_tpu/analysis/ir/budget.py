@@ -1,0 +1,126 @@
+"""The committed IR budget: ``graftcheck-ir-budget.json``.
+
+Per entrypoint (keyed ``name@spec``) the budget commits the expected
+collective census (exact op counts per ``<kind>:<axes>``, bytes within a
+tolerance) and the compiled per-device memory metric (``memory_bytes``, 10%
+headroom). CI compares fresh measurements against it so a PR that silently
+adds an all-gather or grows peak memory past the headroom fails — the
+static-analysis analogue of a perf regression gate, paid at compile time
+instead of on a TPU.
+
+Unlike ``graftcheck-baseline.txt`` (which grandfathers findings), deviations
+here are always failures: the only way to change the numbers is to regenerate
+the file with ``python -m trlx_tpu.analysis.ir --write-budget`` and commit the
+diff, which puts the new collective/memory profile in front of a reviewer.
+"""
+
+import json
+from pathlib import Path
+from typing import Any, Dict, List, Tuple
+
+DEFAULT_BUDGET = "graftcheck-ir-budget.json"
+
+#: collective-bytes and memory headroom before a deviation is a failure.
+#: Counts are exact: one silent extra all-gather is precisely the regression
+#: class this gate exists for.
+BYTES_TOLERANCE_PCT = 10.0
+MEMORY_TOLERANCE_PCT = 10.0
+
+_META_KEYS = ("_format", "_regenerate", "_tolerances")
+
+
+def load(path) -> Dict[str, Any]:
+    p = Path(path)
+    if not p.exists():
+        return {}
+    doc = json.loads(p.read_text())
+    return {k: v for k, v in doc.items() if not k.startswith("_")}
+
+
+def write(path, measurements: Dict[str, Dict[str, Any]]) -> int:
+    doc: Dict[str, Any] = {
+        "_format": (
+            "per-entrypoint AOT audit budget: exact collective counts per "
+            "<kind>:<mesh-axes>, bytes and memory_bytes within the committed "
+            "tolerances (see trlx_tpu/analysis/ir/budget.py)"
+        ),
+        "_regenerate": "python -m trlx_tpu.analysis.ir --write-budget",
+        "_tolerances": {
+            "collective_bytes_pct": BYTES_TOLERANCE_PCT,
+            "memory_pct": MEMORY_TOLERANCE_PCT,
+        },
+    }
+    for key in sorted(measurements):
+        doc[key] = measurements[key]
+    Path(path).write_text(json.dumps(doc, indent=1, sort_keys=False) + "\n")
+    return len(measurements)
+
+
+def compare(
+    measurements: Dict[str, Dict[str, Any]], budget: Dict[str, Any]
+) -> Tuple[List[str], List[str]]:
+    """(violations, notes) between fresh measurements and the committed
+    budget. Violations are IR005/IR006 hard failures; notes are informational
+    (improvements the author may want to lock in by regenerating)."""
+    violations: List[str] = []
+    notes: List[str] = []
+    for key in sorted(measurements):
+        got = measurements[key]
+        want = budget.get(key)
+        if want is None:
+            violations.append(
+                f"IR005 {key}: no committed budget entry — run "
+                f"--write-budget and commit the result"
+            )
+            continue
+        _compare_collectives(key, got.get("collectives", {}), want.get("collectives", {}),
+                             violations, notes)
+        _compare_memory(key, got.get("memory_bytes"), want.get("memory_bytes"),
+                        violations, notes)
+    return violations, notes
+
+
+def _compare_collectives(key, got, want, violations, notes):
+    for ck in sorted(set(got) | set(want)):
+        g, w = got.get(ck), want.get(ck)
+        if w is None:
+            violations.append(
+                f"IR005 {key}: NEW collective {ck} x{g['count']} "
+                f"({g['bytes']} B/step) not in the committed budget"
+            )
+        elif g is None:
+            notes.append(
+                f"IR005 {key}: budgeted collective {ck} x{w['count']} no "
+                f"longer emitted (improvement — regenerate to lock in)"
+            )
+        else:
+            if g["count"] != w["count"]:
+                violations.append(
+                    f"IR005 {key}: {ck} count {w['count']} -> {g['count']}"
+                )
+            if _beyond(g["bytes"], w["bytes"], BYTES_TOLERANCE_PCT):
+                verb = "grew" if g["bytes"] > w["bytes"] else "shrank"
+                violations.append(
+                    f"IR005 {key}: {ck} bytes {verb} {w['bytes']} -> "
+                    f"{g['bytes']} (> {BYTES_TOLERANCE_PCT:g}% tolerance)"
+                )
+
+
+def _compare_memory(key, got, want, violations, notes):
+    if got is None or want is None:
+        return
+    if got > want * (1 + MEMORY_TOLERANCE_PCT / 100.0):
+        violations.append(
+            f"IR006 {key}: memory_bytes {want} -> {got} "
+            f"(+{100.0 * (got - want) / max(want, 1):.1f}% > "
+            f"{MEMORY_TOLERANCE_PCT:g}% headroom)"
+        )
+    elif got < want * (1 - MEMORY_TOLERANCE_PCT / 100.0):
+        notes.append(
+            f"IR006 {key}: memory_bytes improved {want} -> {got} "
+            f"(regenerate to lock in)"
+        )
+
+
+def _beyond(got: int, want: int, pct: float) -> bool:
+    return abs(got - want) > max(want, 1) * pct / 100.0

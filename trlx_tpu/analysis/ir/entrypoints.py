@@ -1,0 +1,108 @@
+"""Registry of AOT-auditable hot entrypoints.
+
+A *hot entrypoint* is one of the handful of jitted steps the paper's training
+loop actually spends its cycles in. Each one registers a **builder** next to
+the code it audits (``methods/ppo.py``, ``methods/ilql.py``,
+``ops/generation.py``) via :func:`register_entrypoint`; the builder constructs
+the step callable and fully **abstract** arguments (``jax.ShapeDtypeStruct``
+trees carrying ``NamedSharding``s over a virtual mesh — nothing is ever
+materialized), mirroring the construction the real trainer performs.
+
+This module is import-light on purpose: registering modules import it at
+module scope, so it must not pull in jax. Builders do their heavy imports
+lazily when called.
+
+Seeded regressions: builders honor ``TRLX_IR_SEED_REGRESSION`` (values
+``f32_upcast`` / ``allgather``) by injecting a deliberate defect into the
+built step. CI uses this to prove the gate actually fails closed; it must
+never be set when writing the committed budget.
+"""
+
+import inspect
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+#: mesh axis sizes every entrypoint audits at by default: small enough for 8
+#: virtual CPU devices (tests/conftest.py), wide enough that fsdp/model
+#: collectives all appear in the compiled HLO.
+DEFAULT_AUDIT_MESH = {"data": 2, "fsdp": 2, "pipe": 1, "model": 2}
+
+
+@dataclass
+class EntryArtifacts:
+    """What a builder returns: everything needed to lower one step."""
+
+    fn: Callable  #: the traceable step callable
+    args: Tuple[Any, ...]  #: abstract ShapeDtypeStruct pytrees, positional
+    donate_argnums: Tuple[int, ...] = ()
+    out_shardings: Any = None  #: optional jit out_shardings
+    #: the precision discipline the step declares; IR001 audits against it
+    compute_dtype: str = "bfloat16"
+    #: IR001 allow-list for this entrypoint: primitive names allowed to run
+    #: heavy ops in f32. ``"dot_general"`` allows any count; ``"dot_general:3"``
+    #: caps it at the registered accumulators (e.g. an f32 value-head output
+    #: layer: 1 forward + 2 backward dots) so a new stray f32 dot still fires
+    f32_allow: frozenset = frozenset()
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class EntryPoint:
+    """One registered entrypoint (name + builder + registration site)."""
+
+    name: str
+    builder: Callable[[str, Any], EntryArtifacts]  #: (spec, mesh) -> artifacts
+    specs: Tuple[str, ...]
+    mesh_shape: Dict[str, int]
+    module: str  #: dotted module of the registration site ("trlx_tpu.methods.ppo")
+    lineno: int  #: line of the builder def, for Finding anchoring
+
+    def rel_path(self) -> str:
+        """Repo-relative posix path of the registering module — the ``path``
+        of every Finding this entrypoint produces, matching the keys the AST
+        graftcheck uses for the same file."""
+        return self.module.replace(".", "/") + ".py"
+
+
+#: name -> EntryPoint; populated by :func:`register_entrypoint` at import time.
+ENTRYPOINTS: Dict[str, EntryPoint] = {}
+
+
+def register_entrypoint(
+    name: str,
+    *,
+    specs: Tuple[str, ...] = ("small",),
+    mesh: Optional[Dict[str, int]] = None,
+):
+    """Decorator registering ``builder(spec, mesh) -> EntryArtifacts``.
+
+    Re-registration under the same name overwrites (the registration is
+    declarative; test re-imports must not error)."""
+
+    def deco(builder):
+        try:
+            lineno = inspect.getsourcelines(builder)[1]
+        except (OSError, TypeError):
+            lineno = 0
+        ENTRYPOINTS[name] = EntryPoint(
+            name=name,
+            builder=builder,
+            specs=tuple(specs),
+            mesh_shape=dict(mesh or DEFAULT_AUDIT_MESH),
+            module=builder.__module__,
+            lineno=lineno,
+        )
+        return builder
+
+    return deco
+
+
+def load_all() -> Dict[str, EntryPoint]:
+    """Import every module that registers hot entrypoints and return the
+    registry. The import list is the audit surface — a new hot step means a
+    new line here plus a ``@register_entrypoint`` at its definition site."""
+    import trlx_tpu.methods.ilql  # noqa: F401
+    import trlx_tpu.methods.ppo  # noqa: F401
+    import trlx_tpu.ops.generation  # noqa: F401
+
+    return dict(ENTRYPOINTS)

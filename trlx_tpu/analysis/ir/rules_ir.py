@@ -1,0 +1,287 @@
+"""IR-level rules IR001–IR006 over lowered/compiled entrypoints.
+
+Same registry as the AST rules (:mod:`trlx_tpu.analysis.core`): each rule has
+an id, a summary, and shows up in ``--list-rules``. The difference is the
+input — an :class:`~trlx_tpu.analysis.ir.lowering.LoweredEntry` instead of a
+:class:`~trlx_tpu.analysis.core.FileContext` — so :class:`IRRule` adds an
+``audit`` method and makes ``check`` (the AST phase) a no-op.
+
+IR001–IR004 yield messages that :func:`audit_entry` turns into ordinary
+:class:`~trlx_tpu.analysis.core.Finding`s anchored at the entrypoint's
+``@register_entrypoint`` site: ``# graftcheck: noqa[IR00x]`` on the builder's
+``def`` line suppresses, and the baseline file grandfathers, exactly as for
+AST findings. IR005/IR006 are declared here for the registry/docs but
+enforced by :mod:`trlx_tpu.analysis.ir.budget` against the committed
+``graftcheck-ir-budget.json`` — budget deviations are never noqa-able.
+"""
+
+from typing import Iterable, List, Optional
+
+from trlx_tpu.analysis.core import RULES, Finding, Rule, register
+from trlx_tpu.analysis.ir.lowering import (
+    LoweredEntry,
+    flat_donated_leaves,
+    iter_eqns,
+)
+
+#: ops where an f32 operand means real f32 FLOPs/bandwidth, not bookkeeping.
+#: Reductions (``reduce_sum(..., dtype=f32)``), converts, and elementwise f32
+#: math are the *allow-listed accumulator* pattern (JX007 demands them) and
+#: are deliberately not in this set.
+HEAVY_PRIMITIVES = frozenset({"dot_general", "conv_general_dilated"})
+
+#: jaxpr primitives that round-trip through the host mid-step.
+HOST_PRIMITIVES = frozenset(
+    {"pure_callback", "io_callback", "debug_callback", "infeed", "outfeed"}
+)
+
+#: IR003 default: closure constants smaller than this ride along for free.
+CONST_BYTES_THRESHOLD = 1 << 20
+
+
+class IRRule(Rule):
+    """A rule over a lowered entrypoint. ``check`` (AST phase) yields
+    nothing; ``audit`` yields message strings for one LoweredEntry."""
+
+    def check(self, ctx) -> Iterable[Finding]:
+        return ()
+
+    def audit(self, lowered: LoweredEntry) -> Iterable[str]:
+        raise NotImplementedError
+
+
+@register
+class UnexpectedF32Ops(IRRule):
+    id = "IR001"
+    summary = (
+        "f32/f64 heavy op (dot/conv) inside a bf16-declared step beyond the "
+        "entrypoint's allow-listed f32 accumulators"
+    )
+
+    def audit(self, lowered: LoweredEntry) -> Iterable[str]:
+        import jax.numpy as jnp
+
+        declared = lowered.artifacts.compute_dtype
+        low_precision = declared in ("bfloat16", "float16")
+        unlimited, caps = _parse_f32_allow(lowered.artifacts.f32_allow)
+        counts = {}
+        first_shape = {}
+        for eqn in iter_eqns(lowered.jaxpr):
+            prim = eqn.primitive.name
+            for var in eqn.outvars:
+                dtype = getattr(getattr(var, "aval", None), "dtype", None)
+                if dtype is None:
+                    continue
+                wide_heavy = (
+                    low_precision
+                    and dtype == jnp.float32
+                    and prim in HEAVY_PRIMITIVES
+                )
+                # f64 anywhere is a config bug regardless of declared dtype
+                # (nothing in this repo enables jax_enable_x64 on purpose)
+                stray_f64 = dtype == jnp.float64
+                if wide_heavy or stray_f64:
+                    k = (prim, str(dtype))
+                    counts[k] = counts.get(k, 0) + 1
+                    first_shape.setdefault(k, tuple(var.aval.shape))
+                    break
+        for (prim, dtype), n in sorted(counts.items()):
+            capped = dtype != "float64" and prim in caps
+            if dtype != "float64":  # f64 is never allow-listable
+                if prim in unlimited:
+                    continue
+                if capped and n <= caps[prim]:
+                    continue
+            over_cap = f" (allow-listed cap is {caps[prim]})" if capped else ""
+            yield (
+                f"{lowered.key}: {n} {dtype} `{prim}` op(s) in a "
+                f"{declared}-declared step{over_cap} (first output shape "
+                f"{first_shape[(prim, dtype)]}); pin the accumulator dtype "
+                f"instead, or allow-list via f32_allow at registration"
+            )
+
+
+@register
+class DonationEffectiveness(IRRule):
+    id = "IR002"
+    summary = (
+        "declared donations the compiled module does not alias, or a "
+        "donat-able large input never declared donated"
+    )
+
+    #: below this, XLA skipping the alias is noise, not a lost buffer
+    min_bytes = 1024
+
+    def audit(self, lowered: LoweredEntry) -> Iterable[str]:
+        import re
+
+        import jax
+        import jax.numpy as jnp
+
+        if lowered.compiled is None:
+            return
+        declared = flat_donated_leaves(lowered.artifacts)
+        aliased = len(
+            re.findall(r"\(\d+,\s*\{[^}]*\},\s*(?:may-alias|must-alias)\)", lowered.hlo_text)
+        )
+        if declared:
+            large = [l for l in declared if _nbytes(l) >= self.min_bytes]
+            if aliased == 0:
+                yield (
+                    f"{lowered.key}: donate_argnums="
+                    f"{lowered.artifacts.donate_argnums} declared but the "
+                    f"compiled module has no input_output_alias — every "
+                    f"donated buffer is copied, not reused"
+                )
+            elif aliased < len(large) // 2:
+                yield (
+                    f"{lowered.key}: only {aliased} of {len(large)} large "
+                    f"donated buffers are aliased by the compiled module; "
+                    f"check output dtypes/shardings match the donated inputs"
+                )
+            return
+        # nothing declared: flag large inputs whose shape+dtype matches an
+        # output — a free donation the step is leaving on the table
+        outs = {
+            (tuple(a.shape), str(a.dtype))
+            for a in lowered.jaxpr.out_avals
+            if hasattr(a, "shape")
+        }
+        missed = 0
+        missed_bytes = 0
+        for arg in lowered.artifacts.args:
+            for leaf in jax.tree.leaves(arg):
+                sig = (tuple(leaf.shape), str(jnp.dtype(leaf.dtype)))
+                if sig in outs and _nbytes(leaf) >= 1 << 20:
+                    missed += 1
+                    missed_bytes += _nbytes(leaf)
+        if missed:
+            yield (
+                f"{lowered.key}: no donations declared but {missed} input "
+                f"buffer(s) ({missed_bytes >> 20} MiB) shape/dtype-match an "
+                f"output — consider donate_argnums"
+            )
+
+
+@register
+class BakedConstants(IRRule):
+    id = "IR003"
+    summary = "large trace-time constant (closure-captured array) baked into the graph"
+
+    def audit(self, lowered: LoweredEntry) -> Iterable[str]:
+        threshold = int(lowered.artifacts.meta.get("const_bytes_threshold", CONST_BYTES_THRESHOLD))
+        for const in lowered.jaxpr.consts:
+            nbytes = _nbytes(const)
+            if nbytes >= threshold:
+                shape = tuple(getattr(const, "shape", ()))
+                dtype = getattr(const, "dtype", type(const).__name__)
+                yield (
+                    f"{lowered.key}: {nbytes >> 20} MiB trace-time constant "
+                    f"{dtype}{list(shape)} baked into the graph — pass it as "
+                    f"an argument so it is sharded/donated like other inputs"
+                )
+
+
+@register
+class HostRoundTrips(IRRule):
+    id = "IR004"
+    summary = "host round-trip (callback/infeed/outfeed) inside a hot step"
+
+    def audit(self, lowered: LoweredEntry) -> Iterable[str]:
+        counts = {}
+        for eqn in iter_eqns(lowered.jaxpr):
+            prim = eqn.primitive.name
+            if prim in HOST_PRIMITIVES:
+                counts[prim] = counts.get(prim, 0) + 1
+        for prim, n in sorted(counts.items()):
+            yield (
+                f"{lowered.key}: {n} `{prim}` op(s) — each one stalls the "
+                f"step on a device→host→device round-trip; hot steps must "
+                f"stay on-device (move it to the host-side epilogue)"
+            )
+
+
+@register
+class CollectiveBudget(IRRule):
+    id = "IR005"
+    summary = (
+        "per-step collective audit (count + bytes per mesh axis) deviates "
+        "from graftcheck-ir-budget.json"
+    )
+    # enforced by trlx_tpu.analysis.ir.budget.compare against the committed
+    # budget, not by audit(): a deviation is a hard CI failure with
+    # --write-budget as the reviewed escape hatch, never a noqa.
+
+    def audit(self, lowered: LoweredEntry) -> Iterable[str]:
+        return ()
+
+
+@register
+class MemoryBudget(IRRule):
+    id = "IR006"
+    summary = "compiled per-device memory accounting exceeds graftcheck-ir-budget.json"
+    # enforced by trlx_tpu.analysis.ir.budget.compare, like IR005.
+
+    def audit(self, lowered: LoweredEntry) -> Iterable[str]:
+        return ()
+
+
+def _parse_f32_allow(allow):
+    """Split an ``f32_allow`` set into (unlimited prims, {prim: max count}).
+
+    ``"dot_general"`` permits any number of f32 dots; ``"dot_general:3"``
+    permits exactly the registered accumulators (e.g. a value head whose
+    output layer is deliberately f32: forward + 2 backward dots) while a
+    NEW f32 dot appearing anywhere in the step still trips IR001."""
+    unlimited = set()
+    caps = {}
+    for entry in allow:
+        prim, sep, n = entry.partition(":")
+        if sep:
+            caps[prim] = int(n)
+        else:
+            unlimited.add(prim)
+    return unlimited, caps
+
+
+def _nbytes(leaf) -> int:
+    nbytes = getattr(leaf, "nbytes", None)
+    if nbytes is not None:
+        return int(nbytes)
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    import numpy as np
+
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * np.dtype(dtype).itemsize
+
+
+def ir_rules() -> List[IRRule]:
+    return [r for r in RULES.values() if isinstance(r, IRRule)]
+
+
+def audit_entry(lowered: LoweredEntry, ctx: Optional[object] = None) -> List[Finding]:
+    """Run IR001–IR004 over one lowered entrypoint, producing Findings
+    anchored at the registration site. ``ctx`` (the registering file's
+    FileContext) enables ``# graftcheck: noqa[IR00x]`` suppression on the
+    builder's def line; without it findings are returned unfiltered."""
+    entry = lowered.entry
+    line_text = ctx.line(entry.lineno) if ctx is not None else ""
+    findings: List[Finding] = []
+    for rule in ir_rules():
+        for msg in rule.audit(lowered):
+            f = Finding(
+                path=entry.rel_path(),
+                lineno=entry.lineno,
+                rule=rule.id,
+                message=msg,
+                line_text=line_text,
+            )
+            if ctx is None or not ctx.suppressed(f):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.rule, f.message))
+    return findings

@@ -417,6 +417,14 @@ class TrainConfig:
     tags: List[str] = field(default_factory=list)
 
     seed: int = 1000
+    # Persistent XLA compilation cache directory. Takes precedence over the
+    # older mesh.compilation_cache_dir knob and the TRLX_COMPILE_CACHE env var
+    # (resolution: trlx_tpu/utils/compilation_cache.py). Must be applied
+    # before the process's FIRST compile — the trainer does this before it
+    # even creates its PRNGKey. Ignored (with a warning) on the CPU backend:
+    # jaxlib 0.4.36 corrupts the heap when executing cache-deserialized
+    # donated executables there; TPU/GPU are unaffected.
+    compilation_cache_dir: Optional[str] = None
     resume_from_checkpoint: Optional[str] = None
     reward_only_on_last: bool = False
     rollout_logging_dir: Optional[str] = None

@@ -15,6 +15,7 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from trlx_tpu.analysis.ir.entrypoints import EntryArtifacts, register_entrypoint
 from trlx_tpu.data.method_configs import MethodConfig, register_method
 from trlx_tpu.utils.modeling import masked_mean
 
@@ -118,3 +119,87 @@ class ILQLConfig(MethodConfig):
             awac_weight=dict(mean=masked_mean(awac_weight, terminal_mask)),
         )
         return loss, stats
+
+
+# -- AOT audit surface (graftcheck-ir) ----------------------------------------
+
+
+@register_entrypoint("ilql_train_step", specs=("small",))
+def build_ilql_train_step(spec: str, mesh) -> EntryArtifacts:
+    """The ILQL learner step as graftcheck-ir audits it: the same
+    ``CausalLMWithILQLHeads`` forward + :meth:`ILQLConfig.loss` + optax update
+    as ``ILQLTrainer._get_train_step``, over fully abstract sharded inputs."""
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from trlx_tpu.data.ilql_types import ILQLBatch
+    from trlx_tpu.models.policy import CausalLMWithILQLHeads
+    from trlx_tpu.models.presets import PRESETS
+    from trlx_tpu.parallel.mesh import BATCH_AXES
+    from trlx_tpu.parallel.sharding import make_param_shardings, make_state_shardings
+
+    dims = {"small": dict(hidden=64, layers=2, heads=4, vocab=256, B=8, T=24, A=7)}[spec]
+    model_config = PRESETS["gpt2"].replace(
+        vocab_size=dims["vocab"], hidden_size=dims["hidden"],
+        num_layers=dims["layers"], num_heads=dims["heads"],
+        intermediate_size=4 * dims["hidden"], max_position_embeddings=64,
+        param_dtype=jnp.float32, compute_dtype=jnp.bfloat16,
+    )
+    module = CausalLMWithILQLHeads(model_config, two_qs=True)
+    method = ILQLConfig()
+
+    params_shape = jax.eval_shape(
+        lambda: module.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 2), jnp.int32), jnp.ones((1, 2), jnp.int32)
+        )
+    )["params"]
+    abs_params = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+        params_shape, make_param_shardings(params_shape, mesh),
+    )
+    tx = optax.adamw(1e-5)
+    opt_shapes = jax.eval_shape(tx.init, abs_params)
+    abs_opt = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+        opt_shapes, make_state_shardings(opt_shapes, mesh),
+    )
+
+    B, T, A = dims["B"], dims["T"], dims["A"]
+    bsh = NamedSharding(mesh, PartitionSpec(BATCH_AXES, None))
+
+    def babs(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=bsh)
+
+    abs_batch = ILQLBatch(
+        input_ids=babs((B, T), jnp.int32),
+        attention_mask=babs((B, T), jnp.int32),
+        rewards=babs((B, A), jnp.float32),
+        states_ixs=babs((B, A + 1), jnp.int32),
+        actions_ixs=babs((B, A), jnp.int32),
+        dones=babs((B, A + 1), jnp.int32),
+    )
+
+    def loss_fn(params, mb):
+        logits, qs, target_qs, vs, _ = module.apply(
+            {"params": params}, mb.input_ids, mb.attention_mask, None,
+            mb.actions_ixs, mb.states_ixs,
+        )
+        action_logits = batched_index_select(logits, mb.actions_ixs)
+        loss, _ = method.loss((action_logits, (qs, target_qs, vs)), mb)
+        return loss
+
+    def train_step(params, opt_state, batch):
+        grads = jax.grad(loss_fn)(params, batch)
+        updates, new_opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt_state
+
+    return EntryArtifacts(
+        fn=train_step,
+        args=(abs_params, abs_opt, abs_batch),
+        donate_argnums=(0, 1),
+        compute_dtype="bfloat16",
+        # q/target-q/v heads all end in a deliberately-f32 Dense
+        # (MLPHead.fc_out): 11 f32 dots for two_qs=True, and no more
+        f32_allow=frozenset({"dot_general:11"}),
+        meta=dict(batch=B, seq=T, actions=A),
+    )

@@ -15,6 +15,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from trlx_tpu.analysis.ir.entrypoints import EntryArtifacts, register_entrypoint
 from trlx_tpu.data.method_configs import MethodConfig, register_method
 from trlx_tpu.utils.modeling import masked_mean, whiten
 
@@ -228,3 +229,129 @@ class PPOConfig(MethodConfig):
                 is_weight_mean=jnp.sum(is_weights * mask, dtype=jnp.float32) / n,
             )
         return loss, stats
+
+
+# -- AOT audit surface (graftcheck-ir) ----------------------------------------
+
+
+@register_entrypoint("ppo_train_step", specs=("small",))
+def build_ppo_train_step(spec: str, mesh) -> EntryArtifacts:
+    """The PPO learner step as graftcheck-ir audits it: the same
+    loss/grad-accum-scan/optax-update construction as
+    ``PPOTrainer._get_train_step`` + ``MeshRLTrainer.make_grad_accum_step``,
+    over fully abstract sharded inputs (nothing materialized — the
+    ``scripts/scale_proof.py`` blueprint at audit shapes).
+
+    ``TRLX_IR_SEED_REGRESSION`` injects a deliberate defect (``f32_upcast``:
+    an f32 logit matmul IR001 must flag; ``allgather``: a replication
+    constraint whose all-gather must break the IR005 budget) so CI can prove
+    the gate fails closed.
+    """
+    import os
+
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from trlx_tpu.data.ppo_types import PPORLBatch
+    from trlx_tpu.models.policy import CausalLMWithValueHead
+    from trlx_tpu.models.presets import PRESETS
+    from trlx_tpu.parallel.mesh import BATCH_AXES
+    from trlx_tpu.parallel.sharding import make_param_shardings, make_state_shardings
+    from trlx_tpu.utils.modeling import logprobs_of_labels
+
+    dims = {"small": dict(hidden=64, layers=2, heads=4, vocab=256, B=8, P=24, R=8)}[spec]
+    model_config = PRESETS["gpt2"].replace(
+        vocab_size=dims["vocab"], hidden_size=dims["hidden"],
+        num_layers=dims["layers"], num_heads=dims["heads"],
+        intermediate_size=4 * dims["hidden"], max_position_embeddings=64,
+        param_dtype=jnp.float32, compute_dtype=jnp.bfloat16,
+    )
+    module = CausalLMWithValueHead(model_config)
+    method = PPOConfig()
+    seed_regression = os.environ.get("TRLX_IR_SEED_REGRESSION", "")
+
+    params_shape = jax.eval_shape(
+        lambda: module.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 2), jnp.int32), jnp.ones((1, 2), jnp.int32)
+        )
+    )["params"]
+    abs_params = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+        params_shape, make_param_shardings(params_shape, mesh),
+    )
+    tx = optax.adamw(1e-5)
+    opt_shapes = jax.eval_shape(tx.init, abs_params)
+    abs_opt = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+        opt_shapes, make_state_shardings(opt_shapes, mesh),
+    )
+
+    B, P, R = dims["B"], dims["P"], dims["R"]
+    bsh = NamedSharding(mesh, PartitionSpec(BATCH_AXES, None))
+
+    def babs(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=bsh)
+
+    abs_batch = PPORLBatch(
+        query_tensors=babs((B, P), jnp.int32),
+        response_tensors=babs((B, R), jnp.int32),
+        logprobs=babs((B, R), jnp.float32),
+        values=babs((B, R), jnp.float32),
+        rewards=babs((B, R), jnp.float32),
+        attention_mask=babs((B, P), jnp.int32),
+        response_mask=babs((B, R), jnp.int32),
+    )
+    num_mb = 2
+
+    def loss_fn(params, mb):
+        seq = jnp.concatenate([mb.query_tensors, mb.response_tensors], axis=1)
+        mask = jnp.concatenate([mb.attention_mask, mb.response_mask], axis=1)
+        logits, values_pred, _, _ = module.apply({"params": params}, seq, mask)
+        if seed_regression == "allgather":
+            # audit seed: replicating the sharded logits forces an all-gather
+            # the committed budget does not contain
+            logits = jax.lax.with_sharding_constraint(
+                logits, NamedSharding(mesh, PartitionSpec())
+            )
+        logprobs = logprobs_of_labels(logits[:, :-1], seq[:, 1:])
+        start = mb.query_tensors.shape[1] - 1
+        logprobs = logprobs[:, start:start + R]
+        values_pred = values_pred[:, start:start + R].astype(jnp.float32)
+        advantages, returns = method.get_advantages_and_returns(
+            mb.values, mb.rewards, mb.response_mask
+        )
+        loss, _ = method.loss(
+            logprobs, values_pred, mb.logprobs, mb.values, advantages, returns,
+            mb.response_mask,
+        )
+        if seed_regression == "f32_upcast":
+            # audit seed: a heavy f32 matmul inside the bf16-declared step
+            logits32 = logits.astype(jnp.float32)
+            probe = jnp.einsum("btv,bsv->ts", logits32, logits32)
+            loss = loss + 0.0 * jnp.sum(probe, dtype=jnp.float32)
+        return loss
+
+    def train_step(params, opt_state, batch):
+        mbs = jax.tree.map(
+            lambda x: x.reshape((num_mb, x.shape[0] // num_mb) + x.shape[1:]), batch
+        )
+
+        def body(grads_acc, mb):
+            grads = jax.grad(loss_fn)(params, mb)
+            return jax.tree.map(jnp.add, grads_acc, grads), None
+
+        grads, _ = jax.lax.scan(body, jax.tree.map(jnp.zeros_like, params), mbs)
+        grads = jax.tree.map(lambda g: g / num_mb, grads)
+        updates, new_opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt_state
+
+    return EntryArtifacts(
+        fn=train_step,
+        args=(abs_params, abs_opt, abs_batch),
+        donate_argnums=(0, 1),
+        compute_dtype="bfloat16",
+        # the value head's output Dense is deliberately f32 (MLPHead.fc_out):
+        # 1 forward + 2 backward dots per step, and no more
+        f32_allow=frozenset({"dot_general:3"}),
+        meta=dict(batch=B, prompt=P, response=R, num_microbatches=num_mb),
+    )

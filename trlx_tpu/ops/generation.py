@@ -20,6 +20,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from trlx_tpu.analysis.ir.entrypoints import EntryArtifacts, register_entrypoint
 from trlx_tpu.ops.sampling import sample_token
 
 # step_fn(params, ids[B,T], mask[B,S], positions[B,T], cache) -> (logits[B,T,V],
@@ -253,3 +254,80 @@ def generate_seq2seq(
             [seqs[:, :1], jnp.where(response_mask > 0, resp, pad_token_id)], axis=1
         )
     return {"sequences": seqs, "response_mask": response_mask}
+
+
+# -- AOT audit surface (graftcheck-ir) ----------------------------------------
+
+
+@register_entrypoint("decode_step", specs=("small", "xl"))
+def build_decode_step(spec: str, mesh) -> EntryArtifacts:
+    """The rollout decode loop as graftcheck-ir audits it: :func:`generate`
+    over a ``TransformerLM`` cached decode — the same jitted callable
+    ``MeshRLTrainer.generate`` builds — with replicated outputs and the
+    sampling pipeline pinned by :data:`trlx_tpu.ops.sampling.AUDIT_GEN_KWARGS`.
+
+    The ``xl`` spec is the 1.5B blueprint from the round-5 scale proof
+    (GPT-2-XL dims, scanned layers): it exists to be *lowered*, deviceless,
+    proving the audit scales past gpt2-small without hardware; CI compiles
+    only ``small``.
+    """
+    from trlx_tpu.models.presets import PRESETS
+    from trlx_tpu.models.transformer import TransformerLM
+    from trlx_tpu.ops.sampling import AUDIT_GEN_KWARGS
+    from trlx_tpu.parallel.mesh import BATCH_AXES
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    dims = {
+        "small": dict(hidden=64, layers=2, heads=4, vocab=256, B=8, P=16, N=8,
+                      scan_layers=False),
+        # GPT-2-XL shapes (~1.5B params): hidden 1600 x 48 layers, 25 heads
+        "xl": dict(hidden=1600, layers=48, heads=25, vocab=50257, B=8, P=128,
+                   N=16, scan_layers=True),
+    }[spec]
+    model_config = PRESETS["gpt2"].replace(
+        vocab_size=dims["vocab"], hidden_size=dims["hidden"],
+        num_layers=dims["layers"], num_heads=dims["heads"],
+        intermediate_size=4 * dims["hidden"],
+        max_position_embeddings=max(1024, dims["P"] + dims["N"]),
+        param_dtype=jnp.float32, compute_dtype=jnp.bfloat16,
+        scan_layers=dims["scan_layers"],
+    )
+    trunk = TransformerLM(model_config)
+
+    params_shape = jax.eval_shape(
+        lambda: trunk.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 2), jnp.int32), jnp.ones((1, 2), jnp.int32)
+        )
+    )["params"]
+    from trlx_tpu.parallel.sharding import make_param_shardings
+
+    abs_params = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+        params_shape, make_param_shardings(params_shape, mesh),
+    )
+
+    B, P, N = dims["B"], dims["P"], dims["N"]
+    bsh = NamedSharding(mesh, PartitionSpec(BATCH_AXES, None))
+    abs_ids = jax.ShapeDtypeStruct((B, P), jnp.int32, sharding=bsh)
+    abs_rng = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+
+    def step_fn(params, ids, mask, positions, cache):
+        logits, hidden, _, cache = trunk.apply({"params": params}, ids, mask, positions, cache)
+        return logits, hidden, cache
+
+    def decode_fn(params, ids, mask, rng):
+        return generate(
+            step_fn, params, lambda b, s: trunk.init_cache(b, s), ids, mask, rng,
+            max_new_tokens=N, eos_token_id=0, pad_token_id=0, **AUDIT_GEN_KWARGS,
+        )
+
+    return EntryArtifacts(
+        fn=decode_fn,
+        args=(abs_params, abs_ids, abs_ids, abs_rng),
+        donate_argnums=(),
+        out_shardings=NamedSharding(mesh, PartitionSpec()),
+        compute_dtype="bfloat16",
+        meta=dict(batch=B, prompt=P, max_new_tokens=N,
+                  hidden_size=dims["hidden"], num_layers=dims["layers"]),
+    )

@@ -126,3 +126,12 @@ def sample_token(
         return jnp.take_along_axis(idx, choice[..., None], axis=-1)[..., 0].astype(jnp.int32)
     logits = apply_top_p(logits, top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+#: The sampling configuration graftcheck-ir's decode audit locks down: the
+#: full temperature -> top-k -> top-p -> categorical pipeline, with the exact
+#: top-k implementation so the compiled HLO is identical across backends
+#: (``approx_max_k`` lowers to a TPU-specific custom call that would fork the
+#: deviceless-CPU budget from the TPU artifact). Changing these changes the
+#: audited decode graph — regenerate graftcheck-ir-budget.json alongside.
+AUDIT_GEN_KWARGS = dict(temperature=0.7, top_k=50, top_p=0.95, top_k_impl="exact")

@@ -116,6 +116,32 @@ def make_mesh(
     return mesh
 
 
+def make_deviceless_mesh(
+    data: int = 1, fsdp: int = 1, pipe: int = 1, model: int = 1
+) -> Mesh:
+    """Mesh over *virtual* CPU host devices, for deviceless AOT lowering
+    (``trlx_tpu/analysis/ir``, compile-only tests).
+
+    The process must already expose enough CPU devices —
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` before jax's first
+    import (``tests/conftest.py`` and ``python -m trlx_tpu.analysis.ir`` both
+    arrange this). Unlike :func:`make_mesh` this bypasses
+    ``mesh_utils.create_device_mesh`` and lays devices out in flat index
+    order: there is no physical topology to optimize for, and the
+    deterministic order is what lets the IR auditor map compiled-HLO
+    ``replica_groups`` back to named mesh axes.
+    """
+    n = data * fsdp * pipe * model
+    devices = [d for d in jax.devices() if d.platform == "cpu"][:n]
+    if len(devices) < n:
+        raise ValueError(
+            f"deviceless mesh {data}x{fsdp}x{pipe}x{model} needs {n} cpu "
+            f"devices but only {len(devices)} exist; set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={n} before jax imports"
+        )
+    return Mesh(np.array(devices).reshape(data, fsdp, pipe, model), MESH_AXES)
+
+
 def mesh_from_config(mesh_config, devices: Optional[Sequence] = None) -> Mesh:
     """Build a mesh from a :class:`trlx_tpu.data.configs.MeshConfig`."""
     return make_mesh(

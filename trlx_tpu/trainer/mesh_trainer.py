@@ -42,6 +42,7 @@ from trlx_tpu.utils import (
     significant,
 )
 from trlx_tpu.utils import logging
+from trlx_tpu.utils.compilation_cache import configure_compilation_cache
 from trlx_tpu.utils.trackers import make_tracker
 
 logger = logging.get_logger(__name__)
@@ -82,18 +83,15 @@ class MeshRLTrainer(BaseRLTrainer):
         # distributed init MUST precede any backend-initializing jax call
         # (PRNGKey creation below queries devices)
         mesh_lib.initialize_distributed()
+        # persistent XLA compile cache: 20-40s first-compiles restore in ms on
+        # subsequent runs with identical shapes. MUST come before the process's
+        # first compile — jax latches cache-enablement at that point, and even
+        # the PRNGKey below compiles a module
+        configure_compilation_cache(config=config)
         self.np_rng = set_seed(config.train.seed)
         # identical on EVERY process: rng is a replicated jit input to generate,
         # and jax requires replicated inputs to be equal across hosts
         self.rng = jax.random.PRNGKey(config.train.seed)
-        cache_dir = getattr(config.mesh, "compilation_cache_dir", None) or os.environ.get(
-            "TRLX_COMPILE_CACHE"
-        )
-        if cache_dir:
-            # persistent XLA compile cache: 20-40s first-compiles restore in ms
-            # on subsequent runs with identical shapes
-            jax.config.update("jax_compilation_cache_dir", cache_dir)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         self.mesh = mesh_lib.mesh_from_config(config.mesh)
         self.tokenizer = load_tokenizer(config.tokenizer)
 

@@ -1,0 +1,95 @@
+"""Persistent XLA compilation cache setup.
+
+One place owns the jax cache knobs because the enablement check is latched:
+``jax._src.compilation_cache.is_cache_used`` memoizes its answer at the FIRST
+compile of the process, so configuring the cache after anything has compiled
+(even a ``jax.random.PRNGKey``) silently disables it for the whole process.
+Callers therefore invoke :func:`configure_compilation_cache` as the first
+jax-touching act: ``MeshRLTrainer.__init__`` before it derives its RNG key,
+and ``python -m trlx_tpu.analysis.ir`` before lowering.
+
+Resolution order for the cache dir: explicit argument, then
+``train.compilation_cache_dir``, then ``mesh.compilation_cache_dir`` (the
+pre-existing knob), then ``$TRLX_COMPILE_CACHE``. Unset everywhere = cache
+off (jax default).
+
+On the CPU backend the cache is configured only for callers that never
+*execute* what they deserialize (``compile_only=True``, e.g. the graftcheck-ir
+AOT gate): with jaxlib 0.4.36, re-loading the PPO grad-accum train step from
+the disk cache and running it corrupts the heap (glibc abort at the next
+step; numerics up to that point are correct, which points at a temp-buffer
+sizing bug in XLA:CPU executable deserialization — other cached executables,
+including the decode step, round-trip fine). TPU/GPU backends are unaffected
+and always honor the configured dir. ``TRLX_COMPILE_CACHE_FORCE=1`` overrides
+the CPU guard for debugging.
+"""
+
+import os
+from typing import Optional
+
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+ENV_VAR = "TRLX_COMPILE_CACHE"
+FORCE_ENV_VAR = "TRLX_COMPILE_CACHE_FORCE"
+
+
+def resolve_cache_dir(config=None, cache_dir: Optional[str] = None) -> Optional[str]:
+    """The effective cache dir for a TRLConfig (or None)."""
+    if cache_dir:
+        return cache_dir
+    if config is not None:
+        train_dir = getattr(getattr(config, "train", None), "compilation_cache_dir", None)
+        if train_dir:
+            return train_dir
+        mesh_dir = getattr(getattr(config, "mesh", None), "compilation_cache_dir", None)
+        if mesh_dir:
+            return mesh_dir
+    return os.environ.get(ENV_VAR) or None
+
+
+def configure_compilation_cache(
+    cache_dir: Optional[str] = None,
+    config=None,
+    min_compile_time_secs: float = 0.5,
+    compile_only: bool = False,
+) -> Optional[str]:
+    """Point jax at an on-disk compile cache; returns the dir, or None when
+    no dir is configured anywhere (or the CPU guard declined — see the module
+    docstring). ``min_compile_time_secs`` trades cache-dir churn for coverage
+    — 0.5s keeps real model steps while skipping the trivial host-side jits;
+    tests pass 0.0 to cache everything. ``compile_only=True`` asserts the
+    caller never executes deserialized executables, which sidesteps the
+    XLA:CPU deserialization bug and so lifts the CPU guard."""
+    cache_dir = resolve_cache_dir(config, cache_dir)
+    if not cache_dir:
+        return None
+
+    import jax
+
+    if (
+        not compile_only
+        and os.environ.get(FORCE_ENV_VAR) != "1"
+        and jax.default_backend() == "cpu"
+    ):
+        logger.warning(
+            f"ignoring compilation cache dir {cache_dir}: executing "
+            "cache-deserialized donated executables corrupts the heap on the "
+            "CPU backend (jaxlib 0.4.36, see trlx_tpu/utils/"
+            f"compilation_cache.py); set {FORCE_ENV_VAR}=1 to force"
+        )
+        return None
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", float(min_compile_time_secs)
+    )
+    try:
+        # cache regardless of artifact size (the default skips small modules)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except AttributeError:
+        pass  # knob absent on older jax; size-based skipping just applies
+    logger.info(f"persistent compilation cache at {cache_dir}")
+    return cache_dir
