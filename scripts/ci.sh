@@ -75,4 +75,17 @@ echo "== resilience tests (CPU)"
 # on real (tiny) trainer runs, and a wedged writer thread must still fail fast
 JAX_PLATFORMS=cpu timeout -k 10 600 \
     python -m pytest tests/test_resilience.py -q -m "not slow" -p no:cacheprovider
+
+echo "== self-healing tests (CPU)"
+# producer supervision, health-guard escalation ladder, experience quarantine;
+# budget sized for a handful of tiny end-to-end runs, and a wedged producer
+# or supervisor livelock must fail fast instead of hanging CI
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_self_healing.py -q -m "not slow" -p no:cacheprovider
+
+echo "== chaos soak smoke (CPU)"
+# the acceptance scenario by name: producer crashes + nan-loss + bad elements
+# + reward faults in one run, every recovery visible in gauges/summary
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_self_healing.py -q -k chaos_soak -p no:cacheprovider
 echo "CI OK"

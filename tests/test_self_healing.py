@@ -1,0 +1,775 @@
+"""Self-healing loop tests (trlx_tpu/rollout/supervisor, trlx_tpu/resilience/
+health+quarantine; docs/resilience.md "Self-healing").
+
+Units cover the supervisor restart/budget machinery against fake producers,
+the health guard's skip -> rollback -> halt ladder, the experience quarantine
+screen, the new chaos sites, and the watchdog escalation hook (satellite S4).
+The end-to-end block proves the acceptance criteria on tiny trainer runs over
+the 8-device virtual CPU mesh: off-by-default parity (bitwise), the combined
+chaos soak with every recovery visible in gauges/summary, rollback-to-last-
+committed-checkpoint, and the fail-closed halt with a diagnostics bundle."""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+import trlx_tpu
+from trlx_tpu.data.configs import (
+    AsyncRolloutConfig,
+    MeshConfig,
+    ModelConfig,
+    ObservabilityConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+    SelfHealingConfig,
+    TokenizerConfig,
+    TrainConfig,
+    TRLConfig,
+)
+from trlx_tpu.data.ppo_types import PPORLElement
+from trlx_tpu.methods.ppo import PPOConfig
+from trlx_tpu.methods.sft import SFTConfig
+from trlx_tpu.obs import watchdog
+from trlx_tpu.obs.watchdog import StallWatchdog
+from trlx_tpu.resilience.chaos import ChaosMonkey, chaos
+from trlx_tpu.resilience.health import (
+    TrainingHealthError,
+    TrainingHealthGuard,
+    chaos_poison_batch,
+    write_diagnostics_bundle,
+)
+from trlx_tpu.resilience.quarantine import (
+    ExperienceQuarantine,
+    chaos_corrupt_elements,
+    validate_element,
+)
+from trlx_tpu.rollout import (
+    AsyncRolloutEngine,
+    ExperienceQueue,
+    ParameterPublisher,
+    ProducerSupervisor,
+    StalenessAccountant,
+)
+from trlx_tpu.rollout.supervisor import ProducerRestartBudgetExceeded
+from trlx_tpu.utils.metrics import gauges
+
+pytestmark = pytest.mark.self_healing
+
+ALPHABET = "abcdefgh "
+
+TINY_MODEL = dict(
+    vocab_size=len(ALPHABET) + 3, hidden_size=32, num_layers=2, num_heads=2,
+    intermediate_size=64, max_position_embeddings=64,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate(monkeypatch):
+    """Chaos disarmed, self-healing gauges cleared, no global watchdog — before
+    AND after every test (all three are process-global)."""
+    monkeypatch.delenv("TRLX_CHAOS", raising=False)
+    chaos.configure(None)
+    gauges.clear("resilience/")
+    watchdog.install(None)
+    yield
+    chaos.configure(None)
+    gauges.clear("resilience/")
+    watchdog.install(None)
+
+
+def make_element(i: int, version: int = 0) -> PPORLElement:
+    return PPORLElement(
+        query_tensor=np.array([i, i + 1], np.int32),
+        response_tensor=np.array([i + 2], np.int32),
+        logprobs=np.array([-0.5], np.float32),
+        values=np.array([0.1], np.float32),
+        rewards=np.array([1.0], np.float32),
+        policy_version=version,
+    )
+
+
+# ------------------------------------------------------------------ config
+
+
+def test_self_healing_config_defaults_and_roundtrip():
+    from trlx_tpu.data.default_configs import default_ppo_config
+
+    config = default_ppo_config()
+    sh = config.train.self_healing
+    assert sh.enabled is False  # off by default: parity with the seed behavior
+    assert sh.max_producer_restarts == 5
+    assert sh.rollback_after == 3 and sh.max_rollbacks == 2
+    d = config.to_dict()
+    assert d["train"]["self_healing"]["enabled"] is False
+    assert TRLConfig.from_dict(d).to_dict() == d
+
+    new = TRLConfig.update(
+        d,
+        {
+            "train.self_healing.enabled": True,
+            "train.self_healing.rollback_after": 5,
+            "train.self_healing.wedge_timeout_s": None,
+        },
+    )
+    assert new.train.self_healing.enabled is True
+    assert new.train.self_healing.rollback_after == 5
+    assert new.train.self_healing.wedge_timeout_s is None
+    with pytest.raises(ValueError):
+        TRLConfig.update(d, {"train.self_healing.bogus_knob": 1})
+
+
+# ------------------------------------------------------------------- chaos
+
+
+def test_new_chaos_sites_parse_and_budget():
+    monkey = ChaosMonkey("producer-wedge:2,nan-loss:1,bad-element:3")
+    assert monkey.armed
+    assert monkey.should_fail("producer-wedge")
+    assert monkey.should_fail("producer-wedge")
+    assert not monkey.should_fail("producer-wedge")  # budget of 2, exactly
+    assert monkey.should_fail("nan-loss")
+    assert not monkey.should_fail("nan-loss")
+    assert monkey.stats() == {"producer-wedge": 2, "nan-loss": 1}
+    with pytest.raises(ValueError, match="unknown site"):
+        monkey.configure("producer-hedge:1")
+
+
+def test_chaos_poison_batch_nans_floats_only():
+    batch = {
+        "ids": np.arange(4, dtype=np.int32),
+        "logprobs": np.ones(4, np.float32),
+    }
+    assert chaos_poison_batch(batch) is batch  # unarmed: free passthrough
+    chaos.configure("nan-loss:1")
+    out = chaos_poison_batch(batch)
+    assert np.all(np.isnan(out["logprobs"]))
+    assert np.array_equal(out["ids"], batch["ids"])  # ints untouched
+    assert np.all(np.isfinite(batch["logprobs"]))  # original not mutated
+    assert chaos_poison_batch(batch) is batch  # budget consumed
+
+
+def test_chaos_corrupt_elements_first_only():
+    elements = [make_element(i) for i in range(3)]
+    assert chaos_corrupt_elements(elements) is elements  # unarmed
+    chaos.configure("bad-element:1")
+    assert chaos_corrupt_elements([]) == []  # empty list never burns budget
+    out = chaos_corrupt_elements(elements)
+    assert np.all(np.isnan(np.asarray(out[0].logprobs)))
+    assert out[1] is elements[1] and out[2] is elements[2]
+    assert chaos_corrupt_elements(elements) is elements  # budget consumed
+
+
+# -------------------------------------------------------------- quarantine
+
+
+def test_validate_element_reasons():
+    assert validate_element(make_element(0)) is None
+    empty = make_element(0).replace(response_tensor=np.array([], np.int32))
+    assert validate_element(empty) == "empty response"
+    for field in ("logprobs", "values", "rewards"):
+        bad = make_element(0).replace(**{field: np.array([np.nan], np.float32)})
+        assert validate_element(bad) == f"non-finite {field}"
+    inf_bad = make_element(0).replace(rewards=np.array([np.inf], np.float32))
+    assert validate_element(inf_bad) == "non-finite rewards"
+
+
+def test_quarantine_filter_writes_sidecar_and_gauge(tmp_path):
+    quar = ExperienceQuarantine(str(tmp_path / "quar"))
+    good = [make_element(i) for i in range(3)]
+    bad = make_element(9).replace(logprobs=np.array([np.nan], np.float32))
+    clean = quar.filter(good + [bad], context="iter=7")
+    assert clean == good
+    assert quar.count == 1
+    assert gauges.get("resilience/quarantined") == 1.0
+    with open(quar.path) as f:
+        records = [json.loads(line) for line in f]
+    assert len(records) == 1
+    assert records[0]["reason"] == "non-finite logprobs"
+    assert records[0]["context"] == "iter=7"
+    assert records[0]["response_tokens"] == [11]
+    # appends accumulate across calls
+    assert quar.filter([bad], context="iter=8") == []
+    assert quar.count == 2
+    with open(quar.path) as f:
+        assert len(f.readlines()) == 2
+    assert gauges.get("resilience/quarantined") == 2.0
+
+
+# ------------------------------------------------------------- health guard
+
+
+def _guard(tmp_path, **overrides) -> TrainingHealthGuard:
+    config = SelfHealingConfig(enabled=True, **overrides)
+    return TrainingHealthGuard(config, diagnostics_dir=str(tmp_path / "diag"))
+
+
+def _healthy(grad_norm=1.0, kl=0.1):
+    return {
+        "loss": 0.5,
+        "health/update_applied": 1.0,
+        "health/grad_norm": grad_norm,
+        "policy/sqrt_kl": kl,
+    }
+
+
+_SKIPPED = {
+    "loss": float("nan"),
+    "health/update_applied": 0.0,
+    "health/grad_norm": float("nan"),
+}
+
+
+def test_guard_caps_warm_up_then_track_median(tmp_path):
+    guard = _guard(tmp_path, min_window=3, anomaly_window=8,
+                   grad_norm_spike_factor=10.0)
+    assert guard.grad_norm_cap() == float("inf")
+    for step, gn in enumerate([1.0, 1.2, 1.1]):
+        assert guard.observe(_healthy(grad_norm=gn), step) == "ok"
+    assert guard.grad_norm_cap() == pytest.approx(11.0)  # 10 x median(1.0,1.1,1.2)
+
+
+def test_guard_kl_spike_is_anomalous_without_skip(tmp_path):
+    guard = _guard(tmp_path, min_window=2, kl_spike_factor=2.0)
+    for step in range(3):
+        assert guard.observe(_healthy(kl=0.1), step) == "ok"
+    assert guard.observe(_healthy(kl=10.0), 3) == "anomaly"
+    assert guard.skipped_updates == 0  # the update WAS applied; host-level only
+    assert guard.anomalies[-1]["reasons"][0].startswith("KL spike")
+    # the spike must not have fed (and inflated) the baseline window
+    assert guard._kl_cap() == pytest.approx(0.2)
+
+
+def test_guard_zero_baseline_keeps_caps_disarmed(tmp_path):
+    # A warm-started policy sits at its KL reference: the window fills with
+    # sqrt_kl ~ 0 and a ratio cap armed off that median (10 x 0 = 0) would
+    # flag every healthy step once the policy starts moving. A ~zero median
+    # must read as "no usable baseline", not as a zero threshold.
+    guard = _guard(tmp_path, min_window=2, kl_spike_factor=10.0,
+                   grad_norm_spike_factor=10.0)
+    for step in range(4):
+        assert guard.observe(_healthy(grad_norm=0.0, kl=0.0), step) == "ok"
+    assert guard._kl_cap() == float("inf")
+    assert guard.grad_norm_cap() == float("inf")
+    # the first real policy movement is healthy, and it seeds the baseline
+    assert guard.observe(_healthy(grad_norm=0.5, kl=0.3), 4) == "ok"
+    assert guard.anomalies == []
+
+
+def test_guard_ladder_skip_rollback_halt(tmp_path):
+    guard = _guard(tmp_path, min_window=2, rollback_after=2, max_rollbacks=1)
+    for step in range(2):
+        assert guard.observe(_healthy(), step) == "ok"
+
+    assert guard.observe(_SKIPPED, 2) == "anomaly"
+    assert guard.skipped_updates == 1
+    assert gauges.get("resilience/skipped_updates") == 1.0
+    assert guard.observe(_healthy(), 3) == "ok"  # healthy resets the streak
+    assert guard.consecutive_anomalies == 0
+
+    assert guard.observe(_SKIPPED, 4) == "anomaly"
+    assert guard.observe(_SKIPPED, 5) == "rollback"
+    assert guard.rollback_budget_left()
+    guard.on_rollback(5, restored=True)
+    assert guard.rollbacks == 1 and guard.consecutive_anomalies == 0
+    assert gauges.get("resilience/rollbacks") == 1.0
+    assert not guard.rollback_budget_left()
+
+    with pytest.raises(TrainingHealthError, match="diagnostics bundle") as ei:
+        guard.halt(6, "rollback budget exhausted")
+    bundle = str(ei.value).rsplit("diagnostics bundle: ", 1)[1]
+    assert os.path.isfile(os.path.join(bundle, "stacks.txt"))
+    with open(os.path.join(bundle, "bundle.json")) as f:
+        payload = json.load(f)
+    assert payload["kind"] == "health-halt"
+    assert payload["halt_step"] == 6 and payload["rollbacks"] == 1
+    assert len(payload["anomalies"]) == 3
+
+    report = guard.report()
+    assert report["skipped_updates"] == 3
+    assert report["rollbacks"] == 1
+    assert report["anomalies"] == 3
+
+
+def test_diagnostics_bundle_contents(tmp_path):
+    chaos.configure("nan-loss:1")
+    chaos.should_fail("nan-loss")
+    gauges.set("resilience/skipped_updates", 7.0)
+    bundle = write_diagnostics_bundle(
+        str(tmp_path), kind="unit", anomalies=[{"step": 3}], extra={"note": "x"}
+    )
+    with open(os.path.join(bundle, "bundle.json")) as f:
+        payload = json.load(f)
+    assert payload["kind"] == "unit"
+    assert payload["anomalies"] == [{"step": 3}]
+    assert payload["note"] == "x"
+    assert payload["chaos_injected"] == {"nan-loss": 1}
+    assert payload["gauges"]["resilience/skipped_updates"] == 7.0
+    with open(os.path.join(bundle, "stacks.txt")) as f:
+        assert "MainThread" in f.read()
+
+
+# ---------------------------------------------------- engine (S1/S2 + wedge)
+
+
+def _build_engine(produce_fn, capacity=16, close_queue_on_death=True):
+    pub = ParameterPublisher(copy_fn=dict)
+    pub.publish({"step": 0})
+    queue = ExperienceQueue(capacity)
+    engine = AsyncRolloutEngine(
+        produce_fn, pub, queue, StalenessAccountant(max_staleness=8),
+        close_queue_on_death=close_queue_on_death,
+    )
+    return engine, queue
+
+
+def test_collect_raises_on_dead_or_unstarted_producer():
+    """S1: collect on an engine whose thread is gone (never started, or killed
+    without running its except clause) must raise, not poll forever."""
+    engine, _ = _build_engine(lambda params, version: [make_element(0)])
+    with pytest.raises(RuntimeError, match="not running"):
+        engine.collect(1, learner_version=0, timeout=5.0)
+    # a thread that died leaving no error behind (e.g. killed mid-flight)
+    dead = threading.Thread(target=lambda: None)
+    dead.start()
+    dead.join()
+    engine._thread = dead
+    with pytest.raises(RuntimeError, match="no error recorded"):
+        engine.collect(1, learner_version=0, timeout=5.0)
+
+
+def test_engine_shutdown_put_drop_balances_ledger():
+    """S2: elements abandoned mid-put during shutdown land in dropped_shutdown
+    so produced == consumed + dropped_stale + leftover + dropped_shutdown."""
+    counter = {"n": 0}
+
+    def produce(params, version):
+        counter["n"] += 1
+        return [make_element(counter["n"])]
+
+    engine, _ = _build_engine(produce, capacity=1)
+    engine.start()
+    deadline = time.monotonic() + 10.0
+    while engine.summary()["produced"] < 2:
+        assert time.monotonic() < deadline, "producer never reached a blocked put"
+        time.sleep(0.01)
+    stats = engine.stop(timeout=10.0)
+    assert stats["dropped_shutdown"] >= 1
+    assert stats["produced"] == (
+        stats["consumed"] + stats["dropped_stale"]
+        + stats["leftover"] + stats["dropped_shutdown"]
+    )
+
+
+def test_producer_wedge_site_parks_silently_until_abandoned():
+    chaos.configure("producer-wedge:1")
+    engine, queue = _build_engine(
+        lambda params, version: [make_element(0)], close_queue_on_death=False
+    )
+    engine.start()
+    with pytest.raises(TimeoutError):
+        engine.collect(1, learner_version=0, timeout=0.3)
+    assert engine.running  # alive, silent — the failure mode no exception models
+    engine.abandon()
+    engine._thread.join(5.0)
+    assert not engine.running
+    assert not queue.closed  # the shared queue stays open for a successor
+
+
+# -------------------------------------------------------------- supervisor
+
+
+def _make_supervised(produce_for, tmp_path, **kwargs):
+    """Supervisor over engine generations sharing one queue/publisher/accountant;
+    ``produce_for(generation)`` returns the produce_fn for each generation."""
+    pub = ParameterPublisher(copy_fn=dict)
+    pub.publish({"step": 0})
+    queue = ExperienceQueue(16)
+    accountant = StalenessAccountant(max_staleness=8)
+    generation = {"n": 0}
+
+    def factory():
+        fn = produce_for(generation["n"])
+        generation["n"] += 1
+        return AsyncRolloutEngine(
+            fn, pub, queue, accountant, close_queue_on_death=False
+        )
+
+    kwargs.setdefault("backoff_base_s", 0.01)
+    kwargs.setdefault("backoff_max_s", 0.05)
+    kwargs.setdefault("diagnostics_dir", str(tmp_path / "diag"))
+    return ProducerSupervisor(factory, **kwargs), queue
+
+
+def test_supervisor_restarts_crashed_producer(tmp_path):
+    def produce_for(generation):
+        if generation == 0:
+            def crash(params, version):
+                raise RuntimeError("synthetic producer crash")
+            return crash
+        return lambda params, version: [make_element(generation)]
+
+    sup, queue = _make_supervised(produce_for, tmp_path, wedge_timeout_s=None)
+    sup.start()
+    try:
+        got = sup.collect(2, learner_version=0, timeout=30.0)
+        assert len(got) == 2
+        assert sup.restarts == 1
+        assert gauges.get("resilience/restarts") == 1.0
+        assert "producer died" in sup.restart_history[0]["reason"]
+    finally:
+        stats = sup.stop(timeout=10.0)
+    assert stats["producer_restarts"] == 1
+    assert queue.closed  # stop() still closes the shared queue at the end
+
+
+def test_supervisor_restart_budget_fails_closed(tmp_path):
+    def produce_for(generation):
+        def crash(params, version):
+            raise RuntimeError("permanent failure")
+        return crash
+
+    sup, _ = _make_supervised(
+        produce_for, tmp_path, max_restarts=2, wedge_timeout_s=None
+    )
+    sup.start()
+    try:
+        with pytest.raises(ProducerRestartBudgetExceeded, match="diagnostics bundle") as ei:
+            sup.collect(1, learner_version=0, timeout=30.0)
+    finally:
+        sup.stop(timeout=10.0)
+    assert sup.restarts == 3  # 2 within budget + the one that tripped it
+    bundle = str(ei.value).rsplit("diagnostics bundle: ", 1)[1]
+    with open(os.path.join(bundle, "bundle.json")) as f:
+        payload = json.load(f)
+    assert payload["kind"] == "producer-restart-budget"
+    assert payload["max_restarts"] == 2
+    assert len(payload["restart_history"]) == 2
+    assert os.path.isfile(os.path.join(bundle, "stacks.txt"))
+
+
+def test_supervisor_wedge_timeout_fallback(tmp_path):
+    """A live-but-silent producer is restarted by the collect-side fallback
+    even with no watchdog installed."""
+    release = threading.Event()
+
+    def produce_for(generation):
+        if generation == 0:
+            def wedged(params, version):
+                release.wait(30.0)
+                return []
+            return wedged
+        return lambda params, version: [make_element(generation)]
+
+    sup, _ = _make_supervised(produce_for, tmp_path, wedge_timeout_s=0.3)
+    sup.start()
+    try:
+        got = sup.collect(2, learner_version=0, timeout=30.0)
+        assert len(got) == 2
+        assert sup.restarts == 1
+        assert "wedge timeout" in sup.restart_history[0]["reason"]
+    finally:
+        release.set()
+        sup.stop(timeout=10.0)
+
+
+def test_watchdog_escalation_fires_once_per_episode():
+    """S4: the escalation hook — per-heartbeat callback, once per stall
+    episode, re-armed by a beat, never lethal to the watchdog, unregistrable."""
+    calls = []
+    wd = StallWatchdog(timeout_s=0.05, poll_s=100.0)  # poll manually, no thread
+    wd.escalate("prod", lambda name, age: calls.append((name, age)))
+
+    wd.beat("prod")
+    late = time.monotonic() + 1.0
+    wd.check(now=late)
+    assert len(calls) == 1
+    assert calls[0][0] == "prod" and calls[0][1] > 0.05
+    wd.check(now=late)  # same episode: no second fire
+    assert len(calls) == 1
+    wd.beat("prod")  # progress re-arms the episode
+    wd.check(now=time.monotonic() + 1.0)
+    assert len(calls) == 2
+
+    # a raising escalation must not kill the check (or the watchdog thread)
+    wd.escalate("prod", lambda name, age: 1 / 0)
+    wd.beat("prod")
+    wd.check(now=time.monotonic() + 1.0)
+
+    wd.escalate("prod", None)  # unregister
+    wd.beat("prod")
+    wd.check(now=time.monotonic() + 1.0)
+    assert len(calls) == 2
+
+
+def test_supervisor_restarts_on_watchdog_escalation(tmp_path):
+    """S4 end-to-end at the unit level: stale producer heartbeat -> watchdog
+    escalation -> supervisor restart, with the wedge fallback disabled so the
+    escalation path alone must do the job."""
+    chaos.configure("producer-wedge:1")  # generation 0 parks, beats nothing
+    impl = StallWatchdog(timeout_s=0.2, poll_s=0.05)
+    watchdog.install(impl)
+    impl.start()
+
+    def produce_for(generation):
+        return lambda params, version: [make_element(generation)]
+
+    sup, _ = _make_supervised(produce_for, tmp_path, wedge_timeout_s=None)
+    sup.start()
+    try:
+        got = sup.collect(2, learner_version=0, timeout=30.0)
+        assert len(got) == 2
+        assert sup.restarts == 1
+        assert "watchdog escalation" in sup.restart_history[0]["reason"]
+    finally:
+        sup.stop(timeout=10.0)
+        watchdog.install(None)
+
+
+# ------------------------------------------------------------------- e2e
+
+
+def _sft_config(tmp_path, total_steps=2, **train_overrides):
+    train = dict(
+        seq_length=16, epochs=4, total_steps=total_steps, batch_size=4,
+        minibatch_size=2, checkpoint_interval=2, eval_interval=100,
+        checkpoint_dir=str(tmp_path / "ckpts"),
+        pipeline="PromptPipeline", trainer="SFTTrainer", tracker=None, seed=2,
+    )
+    train.update(train_overrides)
+    return TRLConfig(
+        method=SFTConfig(gen_kwargs=dict(max_new_tokens=4)),
+        train=TrainConfig(**train),
+        model=ModelConfig(model_path="gpt2", num_layers_unfrozen=-1,
+                          model_overrides=dict(TINY_MODEL)),
+        tokenizer=TokenizerConfig(tokenizer_path=f"char://{ALPHABET}"),
+        optimizer=OptimizerConfig(name="adamw", kwargs=dict(lr=1e-3)),
+        scheduler=SchedulerConfig(name="cosine_annealing", kwargs=dict(T_max=100, eta_min=1e-3)),
+        mesh=MeshConfig(data=2, fsdp=2, model=2, compute_dtype="float32"),
+    )
+
+
+def _ppo_config(tmp_path, total_steps=4, self_healing=None, observability=None,
+                async_rollouts=None, **train_overrides):
+    train = dict(
+        seq_length=16, epochs=30, total_steps=total_steps, batch_size=4,
+        minibatch_size=2, checkpoint_interval=100, eval_interval=100,
+        checkpoint_dir=str(tmp_path / "ckpts"),
+        pipeline="PromptPipeline", trainer="PPOTrainer", tracker=None, seed=2,
+    )
+    train.update(train_overrides)
+    cfg = TRLConfig(
+        method=PPOConfig(
+            num_rollouts=4, chunk_size=4, ppo_epochs=1, init_kl_coef=0.01,
+            target=None,
+            gen_kwargs=dict(max_new_tokens=4, do_sample=True, top_k=0, top_p=1.0),
+        ),
+        train=TrainConfig(**train),
+        model=ModelConfig(model_path="gpt2", num_layers_unfrozen=-1,
+                          model_overrides=dict(TINY_MODEL)),
+        tokenizer=TokenizerConfig(tokenizer_path=f"char://{ALPHABET}"),
+        optimizer=OptimizerConfig(name="adamw", kwargs=dict(lr=1e-3)),
+        scheduler=SchedulerConfig(name="cosine_annealing", kwargs=dict(T_max=100, eta_min=1e-3)),
+        mesh=MeshConfig(data=2, fsdp=2, model=2, compute_dtype="float32"),
+    )
+    if self_healing is not None:
+        cfg.train.self_healing = self_healing
+    if observability is not None:
+        cfg.train.observability = observability
+    if async_rollouts is not None:
+        cfg.train.async_rollouts = async_rollouts
+    return cfg
+
+
+PROMPTS = ["ab", "cd", "ef", "gh"] * 2
+
+SFT_SAMPLES = [["ab", "cd"], ["ef", "gh"], ["a b", "c d"], ["e f", "g h"]]
+
+
+def _reward(samples, **kwargs):
+    return [float(s.count("a")) for s in samples]
+
+
+def test_disabled_self_healing_is_bitwise_inert(tmp_path):
+    """Acceptance: with self_healing present but disabled (even with every
+    other knob changed), final params and checkpoint state are byte-identical
+    to a run that never mentions the subsystem."""
+    import jax
+
+    config_a = _sft_config(tmp_path / "a")
+    trainer_a = trlx_tpu.train(samples=SFT_SAMPLES, eval_prompts=["ab"], config=config_a)
+
+    config_b = _sft_config(tmp_path / "b")
+    config_b.train.self_healing = SelfHealingConfig(
+        enabled=False, max_producer_restarts=1, rollback_after=1,
+        max_rollbacks=0, min_window=1, grad_norm_spike_factor=1.0,
+        kl_spike_factor=1.0, wedge_timeout_s=0.1,
+    )
+    trainer_b = trlx_tpu.train(samples=SFT_SAMPLES, eval_prompts=["ab"], config=config_b)
+
+    assert trainer_b.health is None
+    assert trainer_b.self_healing_summary is None
+    assert gauges.snapshot("resilience/") == {}  # the layer never even woke up
+
+    leaves_a = jax.tree.leaves(jax.device_get(trainer_a.params))
+    leaves_b = jax.tree.leaves(jax.device_get(trainer_b.params))
+    assert len(leaves_a) == len(leaves_b)
+    for a, b in zip(leaves_a, leaves_b):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+    with open(os.path.join(config_a.train.checkpoint_dir, "checkpoint_2", "state.json"), "rb") as f:
+        state_a = f.read()
+    with open(os.path.join(config_b.train.checkpoint_dir, "checkpoint_2", "state.json"), "rb") as f:
+        state_b = f.read()
+    assert state_a == state_b
+
+
+def test_chaos_soak_end_to_end(tmp_path, monkeypatch):
+    """The acceptance soak: producer crashes (rollout-producer + reward faults),
+    poisoned train batches (nan-loss), corrupted scored elements (bad-element)
+    — all in one tiny async run that must complete, with every recovery
+    visible in the gauges and the trainer's self_healing_summary."""
+    monkeypatch.setenv("TRLX_CHAOS", "rollout-producer:2,nan-loss:2,bad-element:3,reward:1")
+    config = _ppo_config(
+        tmp_path,
+        total_steps=4,
+        self_healing=SelfHealingConfig(
+            enabled=True, max_producer_restarts=8,
+            restart_backoff_base_s=0.01, restart_backoff_max_s=0.05,
+            wedge_timeout_s=None,
+        ),
+        async_rollouts=AsyncRolloutConfig(
+            enabled=True, max_staleness=4, queue_capacity=32
+        ),
+    )
+    trainer = trlx_tpu.train(
+        reward_fn=_reward, prompts=PROMPTS, eval_prompts=["ab"], config=config
+    )
+    assert trainer.iter_count == 4  # the run survived everything and finished
+
+    summary = trainer.self_healing_summary
+    # 2 rollout-producer faults + 1 reward fault, each killing one generation
+    assert summary["producer_restarts"] == 3
+    assert summary["skipped_updates"] == 2  # both nan-loss batches skipped
+    assert summary["anomalies"] == 2
+    assert summary["rollbacks"] == 0  # 2 consecutive < rollback_after=3
+    assert summary["quarantined"] == 3  # one element per bad-element chunk
+    assert gauges.get("resilience/restarts") == 3.0
+    assert gauges.get("resilience/quarantined") == 3.0
+
+    quarantine_path = os.path.join(
+        config.train.checkpoint_dir, "quarantine", "quarantine.jsonl"
+    )
+    assert os.path.isfile(quarantine_path)
+    with open(quarantine_path) as f:
+        records = [json.loads(line) for line in f]
+    assert len(records) == 3
+    assert all(r["reason"] == "non-finite logprobs" for r in records)
+    # every armed budget was actually spent — the soak tested what it claims
+    assert chaos.stats() == {
+        "rollout-producer": 2, "nan-loss": 2, "bad-element": 3, "reward": 1,
+    }
+
+
+def test_wedge_escalation_end_to_end(tmp_path, monkeypatch):
+    """A watchdog-detected wedge (no exception anywhere) is healed by the
+    supervisor inside a real training run: obs watchdog -> escalation hook ->
+    restart -> run completes."""
+    monkeypatch.setenv("TRLX_CHAOS", "producer-wedge:1")
+    config = _ppo_config(
+        tmp_path,
+        total_steps=2,
+        self_healing=SelfHealingConfig(
+            # a 2s watchdog also pages on legitimate pauses (evals holding the
+            # producer's pause lock, first-step compiles) — harmless extra
+            # restarts by design, so give the budget headroom
+            enabled=True, max_producer_restarts=8,
+            restart_backoff_base_s=0.01, restart_backoff_max_s=0.05,
+            wedge_timeout_s=None,  # escalation path alone must recover
+        ),
+        observability=ObservabilityConfig(enabled=True, watchdog_timeout_s=2.0),
+        async_rollouts=AsyncRolloutConfig(enabled=True, max_staleness=4),
+    )
+    # the library root logger doesn't propagate (no caplog): attach a handler
+    import logging as _logging
+
+    records = []
+
+    class _Capture(_logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    lib_logger = _logging.getLogger("trlx_tpu")
+    handler = _Capture(level=_logging.WARNING)
+    lib_logger.addHandler(handler)
+    try:
+        trainer = trlx_tpu.train(
+            reward_fn=_reward, prompts=PROMPTS, eval_prompts=["ab"], config=config
+        )
+    finally:
+        lib_logger.removeHandler(handler)
+    assert trainer.iter_count == 2
+    assert trainer.self_healing_summary["producer_restarts"] >= 1
+    # the wedge was healed through the escalation hook, not the collect fallback
+    assert any("watchdog escalation" in m for m in records)
+    assert any("chaos: rollout producer wedged" in m for m in records)
+
+
+def test_rollback_restores_last_committed_checkpoint(tmp_path, monkeypatch):
+    """Consecutive anomalies past rollback_after restore the last committed
+    checkpoint through the exact-resume machinery and the run still reaches
+    total_steps once the fault clears."""
+    monkeypatch.setenv("TRLX_CHAOS", "nan-loss:4")
+    config = _ppo_config(
+        tmp_path,
+        total_steps=4,
+        checkpoint_interval=1,
+        self_healing=SelfHealingConfig(
+            enabled=True, rollback_after=2, max_rollbacks=3
+        ),
+    )
+    trainer = trlx_tpu.train(
+        reward_fn=_reward, prompts=PROMPTS, eval_prompts=["ab"], config=config
+    )
+    assert trainer.iter_count == 4
+    summary = trainer.self_healing_summary
+    # steps 1,2 poisoned -> rollback #1 to ckpt_1; retried step 2 and step 3
+    # poisoned -> rollback #2 to ckpt_2; budget of 4 spent, run finishes clean
+    assert summary["rollbacks"] == 2
+    assert summary["skipped_updates"] == 4
+    assert summary["anomalies"] == 4
+
+
+def test_halt_fails_closed_with_diagnostics_bundle(tmp_path, monkeypatch):
+    """An exhausted rollback budget halts the run with TrainingHealthError
+    carrying a diagnostics-bundle path — never an infinite recovery loop.
+    With no committed checkpoint to restore, the budget is still consumed."""
+    monkeypatch.setenv("TRLX_CHAOS", "nan-loss:12")
+    config = _ppo_config(
+        tmp_path,
+        total_steps=4,  # checkpoint_interval=100: nothing ever committed
+        self_healing=SelfHealingConfig(
+            enabled=True, rollback_after=1, max_rollbacks=1
+        ),
+    )
+    with pytest.raises(TrainingHealthError, match="diagnostics bundle") as ei:
+        trlx_tpu.train(
+            reward_fn=_reward, prompts=PROMPTS, eval_prompts=["ab"], config=config
+        )
+    bundle = str(ei.value).rsplit("diagnostics bundle: ", 1)[1]
+    assert os.path.isdir(bundle)
+    assert bundle.startswith(os.path.join(config.train.checkpoint_dir, "diagnostics"))
+    with open(os.path.join(bundle, "bundle.json")) as f:
+        payload = json.load(f)
+    assert payload["kind"] == "health-halt"
+    assert payload["anomalies"]  # the history that led here is in the bundle
+    assert payload["rollbacks"] == 1
+    assert payload["chaos_injected"]["nan-loss"] >= 2
+    assert os.path.isfile(os.path.join(bundle, "stacks.txt"))

@@ -376,6 +376,74 @@ class ResilienceConfig:
 
 
 @dataclass
+class SelfHealingConfig:
+    """Self-healing supervision and recovery (``trlx_tpu/rollout/supervisor.py``,
+    ``trlx_tpu/resilience/health.py``; docs/resilience.md "Self-healing").
+
+    When enabled, three layers keep a run alive through transient faults
+    instead of dying on the first exception or silently training on garbage:
+    a **ProducerSupervisor** restarts a crashed or watchdog-wedged async
+    rollout producer with exponential backoff (resyncing from
+    ``publisher.latest()``), a **TrainingHealthGuard** screens every optimizer
+    step (non-finite loss/grads and grad-norm spikes are skipped on-device;
+    K consecutive anomalies roll back to the last committed checkpoint; an
+    exhausted rollback budget halts with a diagnostics bundle), and an
+    **experience quarantine** diverts invalid rollout elements (non-finite
+    logprobs/values/rewards, empty responses) to a JSONL sidecar. Off (the
+    default) compiles the exact same train step and leaves checkpoint bytes
+    and step stats byte-identical to an unconfigured run.
+
+    :param enabled: master switch for supervisor + health guard + quarantine.
+    :param max_producer_restarts: producer restart budget; exceeding it raises
+        with a diagnostics-bundle path in the message (fail closed).
+    :param restart_backoff_base_s: first restart delay; doubles per restart up
+        to ``restart_backoff_max_s``.
+    :param restart_backoff_max_s: backoff ceiling.
+    :param wedge_timeout_s: supervisor-side wedge fallback — if the learner
+        has been waiting in ``collect`` this long with a live-but-silent
+        producer, restart it. Works without the obs watchdog; the watchdog
+        escalation hook (``StallWatchdog.escalate``) usually fires first.
+        ``None`` disables the fallback (watchdog-escalation only).
+    :param anomaly_window: rolling-window length (in healthy steps) for
+        grad-norm / KL spike baselines.
+    :param min_window: spike detection stays inactive until the window holds
+        this many healthy samples (avoids tripping on warmup noise).
+    :param grad_norm_spike_factor: skip the update when the global grad norm
+        exceeds ``factor`` x the rolling median (enforced inside the compiled
+        step; non-finite loss or grads always skip).
+    :param kl_spike_factor: count an anomaly when ``policy/sqrt_kl`` exceeds
+        ``factor`` x its rolling median.
+    :param rollback_after: K consecutive anomalous steps trigger a rollback
+        to the last committed checkpoint (exact-resume replay from the
+        resilience subsystem).
+    :param max_rollbacks: rollback budget; the next rollback request past it
+        halts with ``TrainingHealthError`` + diagnostics bundle (fail closed).
+    :param quarantine_dir: directory for ``quarantine.jsonl``; ``None`` →
+        ``<checkpoint_dir>/quarantine``.
+    :param diagnostics_dir: directory for halt/budget diagnostics bundles;
+        ``None`` → ``<checkpoint_dir>/diagnostics``.
+    """
+
+    enabled: bool = False
+    max_producer_restarts: int = 5
+    restart_backoff_base_s: float = 0.5
+    restart_backoff_max_s: float = 30.0
+    wedge_timeout_s: Optional[float] = 600.0
+    anomaly_window: int = 32
+    min_window: int = 8
+    grad_norm_spike_factor: float = 10.0
+    kl_spike_factor: float = 10.0
+    rollback_after: int = 3
+    max_rollbacks: int = 2
+    quarantine_dir: Optional[str] = None
+    diagnostics_dir: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        return cls(**config)
+
+
+@dataclass
 class TrainConfig:
     """Training loop hyperparameters (parity: ``TrainConfig``, configs.py:10-120 in reference).
 
@@ -441,6 +509,10 @@ class TrainConfig:
     # auto-resume / reward retries) — see ResilienceConfig and docs/resilience.md.
     resilience: "ResilienceConfig" = field(default_factory=lambda: ResilienceConfig())
 
+    # Self-healing loop (producer supervision / anomaly-guarded updates /
+    # experience quarantine) — see SelfHealingConfig and docs/resilience.md.
+    self_healing: "SelfHealingConfig" = field(default_factory=lambda: SelfHealingConfig())
+
     # score with reward_fn on process 0 only and broadcast the results to every
     # host. None (default) = auto: ON exactly when jax.process_count() > 1 —
     # otherwise every host hits a served reward model with identical requests
@@ -479,6 +551,9 @@ class TrainConfig:
         res = config.get("resilience")
         if isinstance(res, dict):
             config["resilience"] = ResilienceConfig.from_dict(res)
+        sh = config.get("self_healing")
+        if isinstance(sh, dict):
+            config["self_healing"] = SelfHealingConfig.from_dict(sh)
         return cls(**config)
 
 

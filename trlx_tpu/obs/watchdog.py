@@ -66,6 +66,7 @@ class StallWatchdog:
         self._lock = threading.Lock()
         self._beats: Dict[str, float] = {}
         self._fired: Dict[str, float] = {}  # heartbeat -> beat ts already reported
+        self._escalations: Dict[str, Callable[[str, float], None]] = {}
         self._stalls = 0
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -82,6 +83,19 @@ class StallWatchdog:
         with self._lock:
             self._beats.pop(name, None)
             self._fired.pop(name, None)
+
+    def escalate(self, name: str, callback: Optional[Callable[[str, float], None]]):
+        """Register a per-heartbeat escalation: when ``name`` stalls, invoke
+        ``callback(name, age)`` (on the watchdog thread, once per stall
+        episode) in addition to the stack dump. This is how a *recovery*
+        subsystem — e.g. the rollout ``ProducerSupervisor`` — turns a
+        diagnosis into an action: the callback should set a flag and return
+        fast, never block. ``None`` unregisters."""
+        with self._lock:
+            if callback is None:
+                self._escalations.pop(name, None)
+            else:
+                self._escalations[name] = callback
 
     @property
     def stall_count(self) -> int:
@@ -126,6 +140,7 @@ class StallWatchdog:
                     self._stalls += 1
                     stalled.append((name, now - last))
             stalls = self._stalls
+            escalations = {n: cb for n, cb in self._escalations.items()}
         if not stalled:
             return
         gauges.set("obs/stalls", float(stalls))
@@ -141,6 +156,12 @@ class StallWatchdog:
                     self.on_stall(name, age)
                 except Exception as e:  # diagnostics must never kill training
                     logger.warning(f"watchdog on_stall callback failed: {e}")
+            escalation = escalations.get(name)
+            if escalation is not None:
+                try:
+                    escalation(name, age)
+                except Exception as e:  # recovery hooks must never kill the watchdog
+                    logger.warning(f"watchdog escalation for {name!r} failed: {e}")
 
 
 class _NullWatchdog:
@@ -154,6 +175,9 @@ class _NullWatchdog:
         pass
 
     def unregister(self, name: str):
+        pass
+
+    def escalate(self, name: str, callback=None):
         pass
 
     def start(self):

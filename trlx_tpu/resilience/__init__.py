@@ -16,6 +16,13 @@ the trainer drives from ``TRLConfig.train.resilience``:
   host-side calls (reward RPCs, HF hub loads).
 - :mod:`trlx_tpu.resilience.chaos` — ``TRLX_CHAOS`` fault injection that
   proves all of the above in tests.
+- :mod:`trlx_tpu.resilience.health` — :class:`TrainingHealthGuard` escalation
+  ladder (skip anomalous updates on device → roll back to the last committed
+  checkpoint → halt with a diagnostics bundle) behind
+  ``TRLConfig.train.self_healing``.
+- :mod:`trlx_tpu.resilience.quarantine` — :class:`ExperienceQuarantine`
+  screening rollout elements for non-finite numerics / empty responses and
+  diverting offenders to a JSONL sidecar.
 """
 
 from trlx_tpu.resilience.chaos import ChaosInjectedError, ChaosMonkey, chaos
@@ -28,7 +35,18 @@ from trlx_tpu.resilience.checkpoint import (
     write_checkpoint,
     write_json_atomic,
 )
+from trlx_tpu.resilience.health import (
+    TrainingHealthError,
+    TrainingHealthGuard,
+    chaos_poison_batch,
+    write_diagnostics_bundle,
+)
 from trlx_tpu.resilience.preemption import PreemptionHandler
+from trlx_tpu.resilience.quarantine import (
+    ExperienceQuarantine,
+    chaos_corrupt_elements,
+    validate_element,
+)
 from trlx_tpu.resilience.resume import (
     CHECKPOINT_PREFIX,
     checkpoint_step,
@@ -49,12 +67,17 @@ __all__ = [
     "COMMITTED_SENTINEL",
     "ChaosInjectedError",
     "ChaosMonkey",
+    "ExperienceQuarantine",
     "PROTECTED_CHECKPOINTS",
     "PreemptionHandler",
     "Resilience",
     "RetryDeadlineExceeded",
     "RetryPolicy",
+    "TrainingHealthError",
+    "TrainingHealthGuard",
     "chaos",
+    "chaos_corrupt_elements",
+    "chaos_poison_batch",
     "checkpoint_step",
     "find_latest_committed",
     "gc_checkpoints",
@@ -62,7 +85,9 @@ __all__ = [
     "list_checkpoints",
     "mark_committed",
     "retry_call",
+    "validate_element",
     "with_retries",
     "write_checkpoint",
+    "write_diagnostics_bundle",
     "write_json_atomic",
 ]

@@ -20,7 +20,16 @@ of ``site:arg`` tokens:
   left behind, no ``_COMMITTED`` sentinel ever appears);
 - ``preempt-step:N`` — a simulated preemption "signal" is reported once the
   trainer reaches optimizer step ``N`` (exercises the emergency-checkpoint +
-  auto-resume path end-to-end, no real SIGTERM required).
+  auto-resume path end-to-end, no real SIGTERM required);
+- ``producer-wedge:N`` — the async rollout producer *wedges* ``N`` times: it
+  stops beating the watchdog and blocks silently instead of raising
+  (exercises the watchdog-escalation → supervisor-restart path — the failure
+  mode of a hung reward RPC, which no exception-based site can model);
+- ``nan-loss:N`` — the next ``N`` train batches are poisoned to NaN before
+  the optimizer step (exercises the TrainingHealthGuard skip/rollback
+  ladder);
+- ``bad-element:N`` — one element in each of the next ``N`` scored rollout
+  chunks gets non-finite logprobs (exercises the experience quarantine).
 
 Count-based sites are *budgets*: each injected fault decrements the budget, so
 ``reward:2`` means exactly two failures then clean behavior — which is exactly
@@ -44,7 +53,15 @@ logger = logging.get_logger(__name__)
 ENV_VAR = "TRLX_CHAOS"
 
 # count-budget sites; "preempt-step" is threshold-based and handled separately
-_COUNT_SITES = ("reward", "rollout-producer", "hf-load", "checkpoint")
+_COUNT_SITES = (
+    "reward",
+    "rollout-producer",
+    "hf-load",
+    "checkpoint",
+    "producer-wedge",
+    "nan-loss",
+    "bad-element",
+)
 
 
 class ChaosInjectedError(RuntimeError):

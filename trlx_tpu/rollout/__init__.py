@@ -18,6 +18,9 @@ LlamaRL-style pipelined-rollout design; see docs/rollout.md):
 - :mod:`trlx_tpu.rollout.engine` — the producer loop wrapping the trainer's
   jitted generate/score pipeline, tagging every element with the policy
   version it was sampled from.
+- :mod:`trlx_tpu.rollout.supervisor` — self-healing wrapper that restarts a
+  crashed or watchdog-wedged producer with exponential backoff and a bounded
+  restart budget (``TrainConfig.self_healing``; docs/resilience.md).
 
 Enabled via ``TrainConfig.async_rollouts``; the synchronous path stays the
 default and ``max_staleness=0`` falls back to it exactly.
@@ -27,11 +30,14 @@ from trlx_tpu.rollout.engine import AsyncRolloutEngine
 from trlx_tpu.rollout.publisher import ParameterPublisher
 from trlx_tpu.rollout.queue import ExperienceQueue, QueueClosed
 from trlx_tpu.rollout.staleness import StalenessAccountant, staleness_importance_weights
+from trlx_tpu.rollout.supervisor import ProducerRestartBudgetExceeded, ProducerSupervisor
 
 __all__ = [
     "AsyncRolloutEngine",
     "ExperienceQueue",
     "ParameterPublisher",
+    "ProducerRestartBudgetExceeded",
+    "ProducerSupervisor",
     "QueueClosed",
     "StalenessAccountant",
     "staleness_importance_weights",

@@ -22,9 +22,16 @@ Coordination rules (enforced here, relied on by the trainer):
   iteration — the trainer wraps ``evaluate()`` in it because eval shares the
   tokenizer/RNG/generation caches with the producer.
 - A producer crash closes the queue and re-raises from ``collect``/``stop``
-  so a dead producer can never silently starve the learner.
+  so a dead producer can never silently starve the learner. Under a
+  :class:`~trlx_tpu.rollout.supervisor.ProducerSupervisor` the engine is
+  built with ``close_queue_on_death=False``: the crash still re-raises from
+  ``collect``, but the shared queue stays open so a *replacement* engine can
+  keep feeding it (the supervisor catches the raise and restarts).
 - ``stop()`` closes the queue (waking a blocked ``put``), joins the thread,
   and reports drain statistics; no dangling threads after ``learn()``.
+  Elements abandoned mid-``put`` during shutdown are counted as
+  ``dropped_shutdown`` so the drain ledger balances:
+  ``produced == consumed + dropped_stale + leftover + dropped_shutdown``.
 """
 
 import contextlib
@@ -56,12 +63,18 @@ class AsyncRolloutEngine:
         queue: ExperienceQueue,
         accountant: StalenessAccountant,
         name: str = "rollout-producer",
+        close_queue_on_death: bool = True,
     ):
         self._produce = produce_fn
         self.publisher = publisher
         self.queue = queue
         self.accountant = accountant
         self._name = name
+        # True (default, unsupervised): a producer crash closes the queue so
+        # the learner unblocks and the error re-raises. False (supervised):
+        # the queue is shared with successor engines and must outlive us.
+        self._close_queue_on_death = close_queue_on_death
+        self._abandoned = False
         self._stop_evt = threading.Event()
         # held by the producer across one produce iteration; evaluate() takes
         # it to pause production while it shares tokenizer/RNG/generate caches
@@ -75,6 +88,7 @@ class AsyncRolloutEngine:
         self._busy_time = 0.0
         self._wall_start: Optional[float] = None
         self._produced = 0
+        self._dropped_shutdown = 0
 
     # ---------------------------------------------------------------- lifecycle
 
@@ -91,6 +105,19 @@ class AsyncRolloutEngine:
     def _loop(self):
         try:
             while not self._stop_evt.is_set():
+                # chaos site "producer-wedge": simulate a hang (stuck reward
+                # RPC, wedged decode) — no heartbeats, no exception, no
+                # progress, until abandoned or shut down. Deliberately outside
+                # the pause lock so a wedged producer cannot deadlock
+                # evaluate(); the watchdog escalation / supervisor wedge
+                # timeout is what recovers from this.
+                if chaos.should_fail("producer-wedge"):
+                    logger.warning(
+                        "chaos: rollout producer wedged at site 'producer-wedge' "
+                        "(silent, no heartbeats) — waiting for abandon/stop"
+                    )
+                    self._stop_evt.wait()
+                    break
                 with self._pause_lock:
                     if self._stop_evt.is_set():
                         break
@@ -108,11 +135,22 @@ class AsyncRolloutEngine:
                 # Bounded puts with heartbeats between retries: a *gated* queue
                 # (learner mid-epoch, backpressure working as designed) must not
                 # read as a producer stall to the watchdog
-                with span("queue_put"):
-                    while not self.queue.put(tagged, timeout=5.0):
-                        if self._stop_evt.is_set():
-                            break
-                        watchdog.beat(PRODUCER_HEARTBEAT)
+                delivered = False
+                try:
+                    with span("queue_put"):
+                        while not self._stop_evt.is_set():
+                            if self.queue.put(tagged, timeout=5.0):
+                                delivered = True
+                                break
+                            watchdog.beat(PRODUCER_HEARTBEAT)
+                except QueueClosed:
+                    pass
+                if not delivered:
+                    # shutdown raced the put: the batch is lost by design, but
+                    # it must show up in the drain ledger, not vanish from it
+                    with self._stats_lock:
+                        self._dropped_shutdown += len(tagged)
+                    break
                 watchdog.beat(PRODUCER_HEARTBEAT)
                 self._export_gauges()
         except QueueClosed:
@@ -121,8 +159,11 @@ class AsyncRolloutEngine:
             self._error = e
             logger.error(f"async rollout producer died: {type(e).__name__}: {e}")
         finally:
-            # a dead producer must never leave the learner blocked in get()
-            self.queue.close()
+            # a dead producer must never leave the learner blocked in get() —
+            # except under supervision, where the queue is shared with the
+            # replacement engine and collect() detects death by polling
+            if self._close_queue_on_death and not self._abandoned:
+                self.queue.close()
 
     def stop(self, timeout: Optional[float] = 30.0) -> dict:
         """Close the queue, join the producer, return drain statistics."""
@@ -146,6 +187,17 @@ class AsyncRolloutEngine:
             # last gauge values being exported as if still live
             watchdog.unregister(PRODUCER_HEARTBEAT)
             gauges.clear(prefix="rollout/")
+
+    def abandon(self):
+        """Give up on this engine without draining it (supervisor restart path).
+
+        Sets the stop event (a wedged-by-chaos or healthy producer exits at
+        the next check) but does NOT close the shared queue and does NOT join:
+        a genuinely wedged thread cannot be joined, and as a daemon it is
+        harmless once abandoned. Its finally-clause is told not to close the
+        queue either, so the successor engine keeps feeding the same queue."""
+        self._abandoned = True
+        self._stop_evt.set()
 
     @property
     def running(self) -> bool:
@@ -180,6 +232,15 @@ class AsyncRolloutEngine:
                     raise RuntimeError(
                         f"experience queue closed after {len(out)}/{n} rollouts"
                     )
+                # liveness, not just error state: a producer killed without
+                # running its except-clause (or never started) leaves _error
+                # unset and the queue open — with timeout=None this loop would
+                # otherwise poll an empty queue forever
+                if not self.running and not self._stop_evt.is_set() and self.queue.qsize() == 0:
+                    raise RuntimeError(
+                        f"async rollout producer is not running (no error recorded); "
+                        f"collected {len(out)}/{n} rollouts from an empty open queue"
+                    )
                 continue
             fresh, dropped = self.accountant.admit(got, learner_version)
             if dropped:
@@ -208,10 +269,15 @@ class AsyncRolloutEngine:
         s = self.accountant.stats()
         with self._stats_lock:
             produced = self._produced
+            dropped_shutdown = self._dropped_shutdown
         return {
             "produced": produced,
-            "consumed": q["total_got"],
+            # admitted-to-the-learner count, NOT raw queue pops: with
+            # ``leftover`` stamped by stop(), the drain ledger balances as
+            # produced == consumed + dropped_stale + leftover + dropped_shutdown
+            "consumed": s["admitted"],
             "dropped_stale": s["dropped_stale"],
+            "dropped_shutdown": dropped_shutdown,
             "peak_queue_depth": q["peak_depth"],
             "overlap_fraction": self.overlap_fraction(),
             "staleness_mean": s["staleness_mean"],
@@ -226,7 +292,9 @@ class AsyncRolloutEngine:
         gauges.set("rollout/queue_gated", q["gated"])
         with self._stats_lock:
             produced = self._produced
+            dropped_shutdown = self._dropped_shutdown
         gauges.set("rollout/produced", float(produced))
+        gauges.set("rollout/dropped_shutdown", float(dropped_shutdown))
         gauges.set("rollout/dropped_stale", float(s["dropped_stale"]))
         gauges.set("rollout/staleness_mean", float(s["staleness_last_mean"]))
         gauges.set("rollout/staleness_max", float(s["staleness_max"]))

@@ -30,7 +30,7 @@ from trlx_tpu.ops.generation import generate as generate_op
 from trlx_tpu.ops.generation import generate_seq2seq, left_pad_batch, pad_to_bucket
 from trlx_tpu.parallel import mesh as mesh_lib
 from trlx_tpu.pipeline.tokenization import load_tokenizer
-from trlx_tpu.resilience import Resilience, find_latest_committed
+from trlx_tpu.resilience import Resilience, chaos_poison_batch, find_latest_committed
 from trlx_tpu.trainer import BaseRLTrainer, register_trainer
 from trlx_tpu.utils import (
     Clock,
@@ -133,6 +133,22 @@ class MeshRLTrainer(BaseRLTrainer):
             config.train.resilience, multiprocess=jax.process_count() > 1
         )
         self.reward_fn = self.resilience.wrap_reward_fn(self.reward_fn)
+        # self-healing health guard (skip -> rollback -> halt escalation
+        # ladder; docs/resilience.md). None when disabled — the compiled train
+        # step and the learn loop are then byte-identical to an unconfigured
+        # run. Must exist before any train step is built: make_grad_accum_step
+        # compiles the on-device skip guard only when a guard is present.
+        self.health = None
+        sh_config = config.train.self_healing
+        if sh_config.enabled:
+            from trlx_tpu.resilience.health import TrainingHealthGuard
+
+            self.health = TrainingHealthGuard(
+                sh_config,
+                diagnostics_dir=sh_config.diagnostics_dir
+                or os.path.join(config.train.checkpoint_dir, "diagnostics"),
+            )
+        self.self_healing_summary = None
 
     # ------------------------------------------------------------- model setup
 
@@ -273,9 +289,20 @@ class MeshRLTrainer(BaseRLTrainer):
         accelerate_base_trainer.py:502-516), then one optax update.
 
         ``loss_fn(params, microbatch) -> (loss, stats_dict)``.
+
+        With the self-healing health guard active (``train.self_healing``),
+        the step takes one extra *traced* scalar — the grad-norm cap — and
+        discards the computed update on device when the loss or global grad
+        norm is non-finite or the norm exceeds the cap: the input buffers are
+        donated, so by the time the host could inspect the stats the old
+        params are already gone — the skip decision has to live inside the
+        XLA program (the ``optax.apply_if_finite`` pattern). The cap is a
+        traced argument precisely so the guard's rolling threshold never
+        triggers a retrace. Without a guard the exact original program is
+        compiled — off-config runs stay bit-identical.
         """
 
-        def step(params, opt_state, batch):
+        def compute_update(params, opt_state, batch):
             mbs = jax.tree.map(lambda x: x.reshape((num_mb, x.shape[0] // num_mb) + x.shape[1:]), batch)
 
             def body(grads_acc, mb):
@@ -292,9 +319,40 @@ class MeshRLTrainer(BaseRLTrainer):
             mean_stats["learning_rate_group_0"] = self.lr_schedule(
                 _opt_step_count(opt_state)
             )
+            return new_params, new_opt_state, mean_stats, losses, grads
+
+        def step(params, opt_state, batch):
+            new_params, new_opt_state, mean_stats, _, _ = compute_update(params, opt_state, batch)
             return new_params, new_opt_state, mean_stats
 
-        return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+        guard = self.health
+        if guard is None:
+            return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+        def guarded_step(params, opt_state, batch, grad_norm_cap):
+            new_params, new_opt_state, mean_stats, losses, grads = compute_update(
+                params, opt_state, batch
+            )
+            grad_norm = optax.global_norm(grads)
+            loss_mean = jnp.mean(losses)
+            ok = (
+                jnp.isfinite(loss_mean)
+                & jnp.isfinite(grad_norm)
+                & (grad_norm <= grad_norm_cap)
+            )
+            keep = lambda new, old: jnp.where(ok, new, old)  # noqa: E731
+            new_params = jax.tree.map(keep, new_params, params)
+            new_opt_state = jax.tree.map(keep, new_opt_state, opt_state)
+            mean_stats["health/grad_norm"] = grad_norm
+            mean_stats["health/update_applied"] = ok.astype(jnp.float32)
+            return new_params, new_opt_state, mean_stats
+
+        jitted = jax.jit(guarded_step, donate_argnums=(0, 1) if donate else ())
+
+        def run(params, opt_state, batch):
+            return jitted(params, opt_state, batch, jnp.float32(guard.grad_norm_cap()))
+
+        return run
 
     # -------------------------------------------------------------- generation
 
@@ -632,6 +690,11 @@ class MeshRLTrainer(BaseRLTrainer):
         try:
             return self._learn_loop()
         finally:
+            if self.health is not None:
+                # the run summary half of "visible in gauges and the run
+                # summary" — stashed on the trainer so callers/tests see it
+                self.self_healing_summary = self.health.report()
+                logger.info(f"self-healing summary: {self.self_healing_summary}")
             self.on_learn_end()
             # after the engine drain: the writer flush below may be the
             # emergency checkpoint, and the producer must not race it
@@ -687,6 +750,9 @@ class MeshRLTrainer(BaseRLTrainer):
                         elif self.iter_count >= train_config.profile_end_step and profiling:
                             jax.profiler.stop_trace()
                             profiling = False
+                    # chaos site "nan-loss": poison the batch to non-finite
+                    # (free when unarmed) — the health guard must catch it
+                    batch = chaos_poison_batch(batch)
                     self.clock.tick()  # reset: measure train_step alone
                     # drop the rollout param copy BEFORE the step: fwd+bwd+update is
                     # the peak-memory window and the copy is stale after it anyway
@@ -697,6 +763,18 @@ class MeshRLTrainer(BaseRLTrainer):
                     self.iter_count += 1
                     self.obs.beat("learner")
                     self.post_backward_callback()
+
+                    if self.health is not None:
+                        action = self.health.observe(stats, self.iter_count)
+                        if action == "rollback":
+                            # may raise TrainingHealthError when the budget is
+                            # exhausted (fail closed, diagnostics bundle path
+                            # in the message)
+                            self._handle_health_rollback()
+                            # the rest of this epoch's batches came from the
+                            # anomalous policy — re-collect experience instead
+                            # (post_epoch_callback refills the store)
+                            break
 
                     if self.resilience.should_stop(self.iter_count):
                         return self._preempt_exit(stats)
@@ -861,6 +939,53 @@ class MeshRLTrainer(BaseRLTrainer):
             )
         self._report_sweep_result(results)
         return results
+
+    def _handle_health_rollback(self):
+        """Escalation-ladder step 2/3: the health guard saw ``rollback_after``
+        consecutive anomalies. Restore the newest committed checkpoint if the
+        rollback budget allows, else halt (raises :class:`TrainingHealthError`
+        with a diagnostics bundle path — fail closed, never spin forever)."""
+        if not self.health.rollback_budget_left():
+            self.health.halt(
+                self.iter_count,
+                f"rollback budget exhausted ({self.health.config.max_rollbacks}) "
+                f"with anomalies still occurring",
+            )
+        restored = self._health_rollback()
+        self.health.on_rollback(self.iter_count, restored)
+
+    def _health_rollback(self) -> bool:
+        """Restore the newest committed checkpoint (exact-resume semantics:
+        iter_count, RNG streams, prompt-stream position). Returns False when
+        no committed checkpoint exists yet — the guard still burns a unit of
+        rollback budget so a run that anomalizes before its first checkpoint
+        cannot loop forever."""
+        target = None
+        writer = self.resilience.writer
+        if writer is not None:
+            # an in-flight async commit may be the freshest good state; wait
+            # for it (this also re-raises any writer error now, not later)
+            writer.wait()
+            target = writer.last_committed
+        if target is None:
+            target = find_latest_committed(self.config.train.checkpoint_dir)
+        if target is None:
+            logger.warning(
+                f"health rollback requested but no committed checkpoint exists "
+                f"in {self.config.train.checkpoint_dir} — continuing with "
+                f"current (possibly damaged) state"
+            )
+            return False
+        self.load(target)
+        self._post_rollback_restore()
+        return True
+
+    def _post_rollback_restore(self):
+        """Re-anchor run state that :meth:`load` cannot rebuild by itself
+        after a *mid-run* restore (vs. startup resume). Subclasses override:
+        PPO rebuilds its prompt stream and republishes the restored params to
+        the async producer."""
+        pass
 
     def save(self, directory: str):
         """Sharded checkpoint (params, opt_state, state.json) via orbax (parity:

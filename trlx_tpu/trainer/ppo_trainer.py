@@ -9,6 +9,7 @@ SPMD programs; the reference's rank-0 ``broadcast``/``scatter`` of reward scores
 placed onto the mesh with the batch.
 """
 
+import os
 import time
 from typing import Dict, List
 
@@ -30,6 +31,7 @@ from trlx_tpu.obs import span
 from trlx_tpu.parallel import mesh as mesh_lib
 from trlx_tpu.parallel.sharding import make_param_shardings
 from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
+from trlx_tpu.resilience.quarantine import chaos_corrupt_elements
 from trlx_tpu.trainer import register_trainer
 from trlx_tpu.trainer.mesh_trainer import MeshRLTrainer
 from trlx_tpu.utils import infinite_loader, logging
@@ -56,7 +58,9 @@ class PPOTrainer(MeshRLTrainer):
         self._train_steps = {}
 
         # async rollout engine state (trlx_tpu/rollout; resolved in
-        # prepare_learning — None means the synchronous path)
+        # prepare_learning — None means the synchronous path). Under
+        # train.self_healing this is a ProducerSupervisor wrapping engine
+        # generations; it exposes the same surface
         self._engine = None
         self._async_cfg = None
         self._policy_version = 0
@@ -66,6 +70,20 @@ class PPOTrainer(MeshRLTrainer):
         # restarted run continues the exact prompt sequence
         self._prompt_batches_drawn = 0
         self._resume_prompt_batches = 0
+        self._prompt_pipeline = None
+
+        # experience quarantine (trlx_tpu/resilience/quarantine): screens
+        # every assembled PPORLElement when self-healing is on; None = the
+        # historical trust-everything behavior
+        self._quarantine = None
+        sh_config = config.train.self_healing
+        if sh_config.enabled:
+            from trlx_tpu.resilience.quarantine import ExperienceQuarantine
+
+            self._quarantine = ExperienceQuarantine(
+                sh_config.quarantine_dir
+                or os.path.join(config.train.checkpoint_dir, "quarantine")
+            )
 
         if config.train.rollout_logging_dir is not None:
             self.log_rollouts = True
@@ -353,6 +371,9 @@ class PPOTrainer(MeshRLTrainer):
         wants the widest batch that fits); reward/scoring still run per
         ``chunk_size`` sub-chunk."""
         batch = self.method.decode_batch_size or self.method.chunk_size
+        # kept so a health-guard rollback can rebuild the stream from scratch
+        # and replay draws to the restored position (an iterator can't rewind)
+        self._prompt_pipeline = pipeline
         loader = pipeline.create_loader(batch, shuffle=True, seed=self.config.train.seed)
         self.prompt_iterator = infinite_loader(loader)
 
@@ -652,6 +673,7 @@ class PPOTrainer(MeshRLTrainer):
         accumulated_kl.append(mean_kl)
 
         kl_coef = self.kl_ctl.value
+        new_elements = []
         for i in range(len(prompts)):
             l = int(r_mask[i].sum())
             rewards = -kl_coef * log_ratio[i, :l]
@@ -660,7 +682,7 @@ class PPOTrainer(MeshRLTrainer):
                 rewards[: min(l, len(ds))] += ds[: min(l, len(ds))]
             else:
                 rewards[l - 1] += scores[i]
-            ppo_rl_elements.append(
+            new_elements.append(
                 PPORLElement(
                     query_tensor=np.asarray(prompts[i], np.int32),
                     response_tensor=r_ids[i, :l],
@@ -669,6 +691,16 @@ class PPOTrainer(MeshRLTrainer):
                     rewards=rewards.astype(np.float32),
                 )
             )
+        # experience crosses a trust boundary here: this is the single choke
+        # point both the sync path (make_experience) and the async producer
+        # assemble elements through, so the quarantine screen covers both.
+        # chaos site "bad-element" fabricates an offender first (free unarmed)
+        new_elements = chaos_corrupt_elements(new_elements)
+        if self._quarantine is not None:
+            new_elements = self._quarantine.filter(
+                new_elements, context=f"iter={self.iter_count}"
+            )
+        ppo_rl_elements.extend(new_elements)
 
 
     # ---------------------------------------------------------- async rollouts
@@ -717,15 +749,39 @@ class PPOTrainer(MeshRLTrainer):
         self._policy_version = publisher.publish(self.params)
         capacity = cfg.queue_capacity or 4 * self.method.num_rollouts
         queue = ExperienceQueue(capacity, cfg.high_watermark, cfg.low_watermark)
-        self._engine = AsyncRolloutEngine(
-            self._produce_rollout_chunk,
-            publisher,
-            queue,
-            StalenessAccountant(cfg.max_staleness),
-        )
+        accountant = StalenessAccountant(cfg.max_staleness)
+        sh_config = self.config.train.self_healing
+        supervised = sh_config.enabled
+
+        def make_engine():
+            # generations share queue/publisher/accountant; under supervision
+            # a dead generation must not close the queue its successor feeds
+            return AsyncRolloutEngine(
+                self._produce_rollout_chunk,
+                publisher,
+                queue,
+                accountant,
+                close_queue_on_death=not supervised,
+            )
+
+        if supervised:
+            from trlx_tpu.rollout import ProducerSupervisor
+
+            self._engine = ProducerSupervisor(
+                make_engine,
+                max_restarts=sh_config.max_producer_restarts,
+                backoff_base_s=sh_config.restart_backoff_base_s,
+                backoff_max_s=sh_config.restart_backoff_max_s,
+                wedge_timeout_s=sh_config.wedge_timeout_s,
+                diagnostics_dir=sh_config.diagnostics_dir
+                or os.path.join(self.config.train.checkpoint_dir, "diagnostics"),
+            )
+        else:
+            self._engine = make_engine()
         self._engine.start()
         logger.info(
-            f"async rollout engine started: queue_capacity={capacity} "
+            f"async rollout engine started{' (supervised)' if supervised else ''}: "
+            f"queue_capacity={capacity} "
             f"(high={queue.high_watermark}, low={queue.low_watermark}), "
             f"max_staleness={cfg.max_staleness}, "
             f"publish_interval={cfg.publish_interval}"
@@ -926,6 +982,34 @@ class PPOTrainer(MeshRLTrainer):
             self._refill_store_async()
         else:
             self.make_experience(self.method.num_rollouts, self.iter_count)
+
+    def _post_rollback_restore(self):
+        """Mid-run health rollback: re-anchor the PPO-specific run state that
+        :meth:`load` alone cannot rebuild. The prompt iterator cannot rewind,
+        so it is rebuilt from the retained pipeline and the restored draw
+        count is replayed (the same exact-resume mechanics as a process
+        restart); the async producer is resynced by publishing the restored
+        params so its next chunk samples from the good policy, not the
+        anomalous one; experience already collected from the bad policy is
+        dropped (post_epoch_callback refills the store after the epoch
+        breaks)."""
+        def reanchor():
+            if self._prompt_pipeline is not None:
+                self.add_prompt_pipeline(self._prompt_pipeline)
+                self._prompt_batches_drawn = 0
+                self._fast_forward_prompt_stream()
+            if self._engine is not None:
+                self._policy_version = self._engine.publisher.publish(self.params)
+                gauges.set("rollout/learner_version", float(self._policy_version))
+
+        if self._engine is not None and self._engine.running:
+            # the producer draws from prompt_iterator between produce
+            # iterations — swap it only while production is paused
+            with self._engine.paused():
+                reanchor()
+        else:
+            reanchor()
+        self.store.clear_history()
 
     def evaluate(self):
         """Eval shares the tokenizer, RNG, and compiled-generate caches with the
