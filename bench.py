@@ -280,6 +280,89 @@ def _gpt2_perf_impl(jax, impl):
     return out
 
 
+def _serving_perf(jax):
+    """Continuous-batching serving engine vs the one-shot rollout decode.
+
+    Mirrors the gpt2 leg's model and shapes so ``serving_new_tok_s`` is
+    directly comparable to ``gpt2_rollout_new_tok_s``: same trunk, same
+    prompt/new-token envelope. The workload is the one continuous batching
+    exists for — more requests than decode slots, a shared prompt prefix, and
+    per-request token budgets spread across [N/4, N] so sequences finish at
+    different steps and freed slots refill mid-flight (the one-shot path pays
+    the full padded batch until the last straggler finishes)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from trlx_tpu.models.presets import PRESETS
+    from trlx_tpu.models.transformer import TransformerLM
+    from trlx_tpu.serving.engine import ServingEngine
+
+    out = {}
+    on_cpu = jax.default_backend() == "cpu"
+    kind = jax.devices()[0].device_kind
+    bw = _peak_bw(kind)
+    base = PRESETS["gpt2"].replace(
+        compute_dtype=jnp.float32 if on_cpu else jnp.bfloat16
+    )
+
+    S, P, N = (4, 32, 8) if on_cpu else (256, 128, 128)  # slots, prompt cap, max new
+    n_req = 3 * S
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, base.vocab_size, P // 2)
+    prompts = [
+        np.concatenate(
+            [shared, rng.integers(1, base.vocab_size, 1 + int(rng.integers(0, P // 2)))]
+        ).astype(np.int32).tolist()
+        for _ in range(n_req)
+    ]
+    budgets = [N // 4 + (i * (3 * N // 4)) // n_req for i in range(n_req)]
+    mean_ctx = sum(len(p) for p in prompts) / n_req + sum(budgets) / n_req / 2
+
+    trunk0 = TransformerLM(base)
+    params = trunk0.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 8), jnp.int32), jnp.ones((1, 8), jnp.int32),
+    )["params"]
+    param_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+
+    def run_once(quant):
+        trunk = TransformerLM(base.replace(kv_cache_quant=quant))
+        engine = ServingEngine(
+            trunk, params, num_slots=S, max_seq_len=P + N,
+            gen_kwargs=dict(do_sample=False), seed=0,
+        )
+
+        def one_pass():
+            uids = [engine.submit(p, n) for p, n in zip(prompts, budgets)]
+            done = engine.run(uids)
+            delivered = sum(len(done[u].generated) for u in uids)
+            for u in uids:
+                engine.scheduler.requests.pop(u, None)
+            return delivered
+
+        one_pass()  # warmup: compiles every prefill bucket + the decode step
+        t0 = time.time()
+        delivered = one_pass()
+        return delivered / (time.time() - t0), engine
+
+    tok_s, engine = run_once(quant=False)
+    out["serving_new_tok_s"] = round(tok_s, 1)
+    tok_s_q, engine_q = run_once(quant=True)
+    out["serving_new_tok_s_int8kv"] = round(tok_s_q, 1)
+
+    summary = engine_q.summary()
+    out["serving_prefix_cache_hit_rate"] = round(summary["prefix_cache_hit_rate"], 4)
+    out["serving_mean_slot_occupancy"] = round(summary["mean_slot_occupancy"], 4)
+    # HBM roofline at the engine's operating point: each decode step streams all
+    # params plus the live slots' mean-context int8 KV; achievable delivered
+    # tok/s scales with how full the engine kept its slots
+    kv_q_bytes = _kv_step_bytes(base, S, int(mean_ctx), 0, None)
+    bound_tok_s = bw / (param_bytes + kv_q_bytes) * S * summary["mean_slot_occupancy"]
+    out["serving_frac_of_bw_bound"] = round(tok_s_q / bound_tok_s, 4)
+    out["serving_num_slots"] = S
+    return out
+
+
 def _big_perf(jax):
     """gpt2-xl-shaped (~1.56B param) single-chip leg: rollout decode + PPO train
     step with the memory machinery on — bf16 params, scan_layers, selective
@@ -544,6 +627,10 @@ def measure():
         result.update(legs.run("gpt2", lambda: _gpt2_perf(jax)))
     except Exception as e:  # never lose the primary metric to the extra one
         result["gpt2_perf_error"] = f"{type(e).__name__}: {e}"
+    try:
+        result.update(legs.run("serving", lambda: _serving_perf(jax)))
+    except Exception as e:
+        result["serving_perf_error"] = f"{type(e).__name__}: {e}"[:300]
     result.update(legs.run("ir_audit", _ir_audit_probe))
     if platform != "cpu":
         try:

@@ -83,6 +83,14 @@ echo "== self-healing tests (CPU)"
 JAX_PLATFORMS=cpu timeout -k 10 600 \
     python -m pytest tests/test_self_healing.py -q -m "not slow" -p no:cacheprovider
 
+echo "== serving tests (CPU)"
+# continuous-batching generation server: paged allocator invariants,
+# scheduler slot turnover, kernel parity (XLA vs Pallas-interpret, bf16/int8),
+# engine/client parity with the one-shot generate path; bounded so a wedged
+# engine loop fails fast instead of hanging CI
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_serving.py tests/test_paged_attention.py -q -m "not slow" -p no:cacheprovider
+
 echo "== chaos soak smoke (CPU)"
 # the acceptance scenario by name: producer crashes + nan-loss + bad elements
 # + reward faults in one run, every recovery visible in gauges/summary

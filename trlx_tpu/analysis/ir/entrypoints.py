@@ -104,5 +104,6 @@ def load_all() -> Dict[str, EntryPoint]:
     import trlx_tpu.methods.ilql  # noqa: F401
     import trlx_tpu.methods.ppo  # noqa: F401
     import trlx_tpu.ops.generation  # noqa: F401
+    import trlx_tpu.ops.paged_attention  # noqa: F401
 
     return dict(ENTRYPOINTS)

@@ -253,6 +253,13 @@ class AsyncRolloutConfig:
     :param collect_timeout_s: learner-side timeout waiting for the producer to
         deliver a full experience batch (surfaces a wedged producer).
     :param drain_timeout_s: shutdown timeout joining the producer thread.
+    :param length_bucket_lookahead: pool this many upcoming producer batches,
+        sort the pooled prompts by length, and re-batch before generation —
+        each ``generate`` call then pads to its own batch's (now much
+        tighter) longest prompt instead of the stream-order worst case.
+        0 disables (stream order preserved exactly, the replay-determinism
+        baseline); the reorder is itself deterministic for a fixed stream,
+        so exact-resume replay stays exact at any value.
     """
 
     enabled: bool = False
@@ -265,6 +272,7 @@ class AsyncRolloutConfig:
     is_ratio_clip: float = 2.0
     collect_timeout_s: float = 600.0
     drain_timeout_s: float = 30.0
+    length_bucket_lookahead: int = 0
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):
@@ -444,6 +452,51 @@ class SelfHealingConfig:
 
 
 @dataclass
+class ServingConfig:
+    """Continuous-batching generation server (``trlx_tpu/serving``;
+    docs/serving.md).
+
+    When enabled, rollout generation runs through a persistent
+    :class:`~trlx_tpu.serving.engine.ServingEngine` — paged KV block pool,
+    in-flight batching (finished sequences replaced mid-decode), prompt-prefix
+    sharing, and the fused paged-decode attention kernel — instead of one-shot
+    ``generate`` calls. Off (the default) leaves the generate path byte-for-
+    byte untouched. The engine requires a single-process causal LM with the
+    per-layer cache layout; unsupported configs (seq2seq, stacked layers,
+    prompt/prefix peft, multi-device mesh, ILQL's logit processor) log a
+    warning and fall back to the generate path.
+
+    :param enabled: route rollout generation through the serving engine.
+    :param num_slots: decode slots (device batch of the steady-state step);
+        0 = the rollout chunk size.
+    :param block_size: tokens per KV block. Smaller = less fragmentation +
+        finer prefix sharing; larger = fewer, larger DMAs per attention step.
+        See docs/serving.md for tuning.
+    :param num_blocks: physical blocks in the pool (one extra is reserved as
+        the null block); 0 = full worst-case reservation for every slot
+        (``num_slots * ceil(max_seq_len / block_size) + 1``).
+    :param kv_cache_quant: int8 KV blocks with per-row f32 scales; None
+        inherits ``model.kv_cache_quant``.
+    :param attention_impl: paged-attention dispatch — "auto" (fused Pallas
+        kernel on single-device TPU, XLA gather elsewhere), "pallas", "xla".
+    :param prefix_caching: ref-counted sharing of full prompt-prefix blocks
+        (flushed automatically whenever the parameter snapshot changes).
+    """
+
+    enabled: bool = False
+    num_slots: int = 0
+    block_size: int = 16
+    num_blocks: int = 0
+    kv_cache_quant: Optional[bool] = None
+    attention_impl: str = "auto"
+    prefix_caching: bool = True
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        return cls(**config)
+
+
+@dataclass
 class TrainConfig:
     """Training loop hyperparameters (parity: ``TrainConfig``, configs.py:10-120 in reference).
 
@@ -513,6 +566,10 @@ class TrainConfig:
     # experience quarantine) — see SelfHealingConfig and docs/resilience.md.
     self_healing: "SelfHealingConfig" = field(default_factory=lambda: SelfHealingConfig())
 
+    # Continuous-batching generation server (paged KV cache / in-flight
+    # batching / prefix sharing) — see ServingConfig and docs/serving.md.
+    serving: "ServingConfig" = field(default_factory=lambda: ServingConfig())
+
     # score with reward_fn on process 0 only and broadcast the results to every
     # host. None (default) = auto: ON exactly when jax.process_count() > 1 —
     # otherwise every host hits a served reward model with identical requests
@@ -554,6 +611,9 @@ class TrainConfig:
         sh = config.get("self_healing")
         if isinstance(sh, dict):
             config["self_healing"] = SelfHealingConfig.from_dict(sh)
+        sv = config.get("serving")
+        if isinstance(sv, dict):
+            config["serving"] = ServingConfig.from_dict(sv)
         return cls(**config)
 
 

@@ -101,6 +101,10 @@ class TransformerConfig:
     # footprint raises the decode bandwidth roofline ~2x (the reference has no
     # analogue; its CUDA decode reads fp16 KV). Scales stored f32 per (b,h,slot).
     kv_cache_quant: bool = False
+    # Paged-KV decode (serving engine): implementation for the block-table
+    # gather attention — "auto" | "pallas" | "xla" (see ops/paged_attention.py;
+    # auto = fused kernel on a single-device TPU, XLA gather elsewhere).
+    paged_attention_impl: str = "auto"
     # Pipeline parallelism (the reference's Apex pipeline engine analogue,
     # modeling_nemo_ppo.py:713-731). > 1 stores block params STACKED ([L, ...]
     # under "layers_scan", sharded over the mesh "pipe" axis) and runs cache-free
@@ -420,6 +424,38 @@ class Attention(nn.Module):
             cos, sin = make_rotary(c, positions)
             q = apply_rotary(q, cos, sin, c.rope_style)
             k = apply_rotary(k, cos, sin, c.rope_style)
+
+        if cache is not None and "block_tables" in cache:
+            # Paged decode (serving engine): single-token step against the
+            # block-pool cache. The new row lands at position context_lens
+            # (its block is always exclusively owned — the allocator never
+            # leaves a live write frontier inside a shared prefix block), then
+            # attention runs over context_lens+1 tokens gathered through the
+            # block table. Causality is structural — only written slots are
+            # valid — so no mask_bias is consumed; alibi (a position-dependent
+            # score bias) and prefix tuning (scale-less prepended rows) don't
+            # fit that contract and the serving engine refuses such configs.
+            if T != 1:
+                raise ValueError("paged cache supports single-token decode steps only")
+            if c.pos_embedding == "alibi" or c.peft_type == "prefix":
+                raise ValueError(
+                    "paged decode does not support alibi or prefix tuning"
+                )
+            from trlx_tpu.ops.paged_attention import (
+                paged_decode_attention, write_paged_kv,
+            )
+
+            new_cache = write_paged_kv(cache, k[:, 0], v[:, 0])
+            out = paged_decode_attention(
+                q[:, 0], new_cache["k"], new_cache["v"],
+                cache["block_tables"], cache["context_lens"] + 1,
+                k_scale=new_cache.get("k_scale"), v_scale=new_cache.get("v_scale"),
+                scale=1.0 / math.sqrt(c.dim_per_head),
+                impl=c.paged_attention_impl,
+            )
+            out = out.reshape(B, 1, c.num_heads * c.dim_per_head).astype(c.compute_dtype)
+            out = dense(c.hidden_size, "o_proj", c.attn_bias, res_std)(out)
+            return out, new_cache
 
         if cache is not None:
             idx = cache["index"]
@@ -1025,3 +1061,65 @@ class TransformerLM(nn.Module):
         }
         out["index"] = jnp.array(0, jnp.int32)
         return out
+
+    def init_paged_cache(
+        self, num_blocks: int, block_size: int, max_blocks_per_seq: int,
+        batch_size: int, dtype=None,
+    ) -> KVCache:
+        """Block-pool cache for the serving engine (see ops/paged_attention.py):
+        per-layer k/v pools ``[num_blocks, block_size, Hkv, D]`` (int8 + f32
+        row scales under ``kv_cache_quant``) plus shared ``block_tables``
+        ``[B, max_blocks_per_seq]`` and ``context_lens`` ``[B]``. Block 0 is
+        the allocator's reserved null block; fresh tables point at it."""
+        from trlx_tpu.ops.paged_attention import paged_pool_layout
+
+        c = self.config
+        if c.stacked:
+            raise NotImplementedError(
+                "paged decode supports the per-layer list layout only "
+                "(scan_layers / pipeline_stages > 1 are unsupported)"
+            )
+        layout = paged_pool_layout(
+            num_blocks, block_size, c.kv_heads, c.dim_per_head,
+            dtype or c.compute_dtype, c.kv_cache_quant,
+        )
+        out = {
+            key: [jnp.zeros(shp, dt) for _ in range(c.num_layers)]
+            for key, (shp, dt) in layout.items()
+        }
+        out["block_tables"] = jnp.zeros((batch_size, max_blocks_per_seq), jnp.int32)
+        out["context_lens"] = jnp.zeros((batch_size,), jnp.int32)
+        return out
+
+    def paged_decode(self, input_ids: jnp.ndarray, cache: KVCache):
+        """One decode step against the paged block-pool cache: ``input_ids``
+        [B, 1], ``cache`` from :meth:`init_paged_cache` (pools possibly
+        populated by the serving engine's prefill scatter). Returns
+        (logits [B, 1, V], hidden [B, 1, Hid], new cache with
+        ``context_lens`` advanced by 1). Idle slots (context_lens == 0 with a
+        null block table row) still produce finite output — the engine
+        discards it."""
+        c = self.config
+        if c.stacked:
+            raise NotImplementedError("paged decode: per-layer list layout only")
+        if c.peft_type in ("prompt", "prefix"):
+            raise NotImplementedError("paged decode does not support peft prompt/prefix")
+        B, T = input_ids.shape
+        lens = cache["context_lens"]
+        positions = lens[:, None].astype(jnp.int32)  # incoming token's position
+        x = self.embed(input_ids, positions)
+        pool_keys = [k for k in cache if k not in ("block_tables", "context_lens")]
+        new_layer_caches = []
+        for i, layer in enumerate(self.layers):
+            layer_cache = {key: cache[key][i] for key in pool_keys}
+            layer_cache["block_tables"] = cache["block_tables"]
+            layer_cache["context_lens"] = lens
+            x, new_lc = layer(x, None, positions, layer_cache, None)
+            new_layer_caches.append(new_lc)
+        logits, hidden = self._final(x)
+        new_cache = {
+            key: [lc[key] for lc in new_layer_caches] for key in pool_keys
+        }
+        new_cache["block_tables"] = cache["block_tables"]
+        new_cache["context_lens"] = lens + 1
+        return logits, hidden, new_cache

@@ -1,0 +1,426 @@
+"""Paged-KV decode attention: gather K/V through a block table.
+
+The serving engine (``trlx_tpu/serving``) stores the KV cache as a pool of
+fixed-size token blocks instead of one contiguous ``[B, Hkv, S, D]`` buffer
+per sequence. Each decode slot addresses its tokens through a per-sequence
+block table, so
+
+- finished sequences release their blocks immediately (continuous batching
+  never pays for the longest straggler's padding),
+- shared prompt prefixes map to the *same* physical blocks (ref-counted by
+  the allocator), and
+- the attention for one step reads exactly ``context_len`` tokens per slot,
+  not the padded maximum.
+
+Two implementations with one contract:
+
+- :func:`paged_attention_xla` — gather + masked softmax in plain XLA. The
+  reference path: runs everywhere (CPU tests, deviceless AOT audit, SPMD
+  meshes where a Mosaic kernel cannot be auto-partitioned).
+- :func:`paged_attention_pallas` — a fused Pallas kernel that walks the block
+  table via scalar prefetch (the table is read in BlockSpec index maps, so
+  each grid step DMAs only its own block) and dequantizes int8 blocks
+  in-register: the per-row scales fold into the scores (k) and the softmax
+  probabilities (v), leaving the HBM stream a pure int8 load — the same
+  algebra the dense decode path uses (models/transformer.py), so the two
+  paths agree numerically.
+
+Layouts (per layer):
+
+- ``k_pool`` / ``v_pool``: ``[num_blocks, block_size, Hkv, D]`` in the cache
+  dtype, or int8 under quantization,
+- ``k_scale`` / ``v_scale``: ``[num_blocks, block_size, Hkv]`` f32 per-row
+  scales (quantized layout only; scheme: :func:`quantize_kv_rows`),
+- ``block_tables``: ``[B, max_blocks]`` int32 physical block ids,
+- ``context_lens``: ``[B]`` int32 — valid tokens per slot INCLUDING the token
+  written this step (so it is always >= 1 for any slot that ran the step;
+  idle slots recycle the reserved null block and their output is discarded
+  by the scheduler, but it must still be finite).
+
+Block 0 is reserved by the allocator as the null block: unused block-table
+entries point at it, keeping every gather in range without masking tricks.
+"""
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from trlx_tpu.analysis.ir.entrypoints import EntryArtifacts, register_entrypoint
+
+NEG_INF = -1e30  # kernel-internal mask value (f32 exact, like ops/attention.py)
+
+
+def _group_query_heads(q: jnp.ndarray, kv_heads: int) -> jnp.ndarray:
+    """[B, H, D] -> [B, Hkv, rep, D] so query head h maps to kv head h // rep."""
+    B, H, D = q.shape
+    return q.reshape(B, kv_heads, H // kv_heads, D)
+
+
+def paged_attention_xla(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    context_lens: jnp.ndarray,
+    *,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Reference path: gather each slot's blocks, mask, softmax in f32.
+
+    q ``[B, H, D]`` (one decode token per slot); returns ``[B, H, D]`` in
+    ``q.dtype``. Scales (when given) fold into scores/probs exactly as the
+    Pallas kernel and the dense int8 decode path do.
+    """
+    B, H, D = q.shape
+    NB, BS, Hkv, _ = k_pool.shape
+    MB = block_tables.shape[1]
+    S = MB * BS
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+
+    # [B, MB, BS, Hkv, D] -> [B, S, Hkv, D]; tables always in range (null block 0)
+    kh = jnp.take(k_pool, block_tables, axis=0).reshape(B, S, Hkv, D)
+    vh = jnp.take(v_pool, block_tables, axis=0).reshape(B, S, Hkv, D)
+    qg = _group_query_heads(q, Hkv)
+
+    scores = jnp.einsum(
+        "bkrd,bskd->bkrs", qg, kh, preferred_element_type=jnp.float32
+    ) * scale
+    if k_scale is not None:
+        ks = jnp.take(k_scale, block_tables, axis=0).reshape(B, S, Hkv)
+        scores = scores * ks.transpose(0, 2, 1)[:, :, None, :]
+    valid = jnp.arange(S)[None, :] < context_lens[:, None]  # [B, S]
+    scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if v_scale is not None:
+        vs = jnp.take(v_scale, block_tables, axis=0).reshape(B, S, Hkv)
+        probs = probs * vs.transpose(0, 2, 1)[:, :, None, :]
+    out = jnp.einsum(
+        "bkrs,bskd->bkrd", probs, vh.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(B, H, D).astype(q.dtype)
+
+
+def _paged_kernel(
+    tables_ref,  # scalar prefetch: [B, MB] int32
+    lens_ref,  # scalar prefetch: [B] int32
+    q_ref,  # [1, 1, rep, D]
+    k_ref,  # [1, BS, 1, D]
+    v_ref,
+    ks_ref,  # [1, BS, 1] f32 or None (bound via partial when quantized)
+    vs_ref,
+    o_ref,  # [1, 1, rep, D]
+    m_scratch,  # [rep, 1] f32
+    l_scratch,  # [rep, 1] f32
+    acc_scratch,  # [rep, D] f32
+    *,
+    block_size: int,
+    num_blocks_per_seq: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scratch[...] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[...] = jnp.zeros_like(l_scratch)
+        acc_scratch[...] = jnp.zeros_like(acc_scratch)
+
+    q = q_ref[0, 0].astype(jnp.float32)  # [rep, D]
+    k = k_ref[0, :, 0, :].astype(jnp.float32)  # [BS, D]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [rep, BS]
+    if ks_ref is not None:
+        s = s * ks_ref[0, :, 0][None, :]
+
+    # token index of each row in this block; valid rows only
+    token_idx = j * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_size), 1
+    )
+    s = jnp.where(token_idx < lens_ref[b], s, NEG_INF)
+
+    m_prev = m_scratch[...]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    # fully-masked blocks keep m == NEG_INF; exp(s - m) would be exp(0) there
+    p = jnp.where(m_new > NEG_INF / 2, jnp.exp(s - m_new), 0.0)  # [rep, BS]
+    alpha = jnp.exp(m_prev - m_new)
+    l_scratch[...] = alpha * l_scratch[...] + jnp.sum(p, axis=1, keepdims=True)
+    if vs_ref is not None:
+        p = p * vs_ref[0, :, 0][None, :]
+    v = v_ref[0, :, 0, :].astype(jnp.float32)  # [BS, D]
+    acc_scratch[...] = acc_scratch[...] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_scratch[...] = m_new
+
+    @pl.when(j == num_blocks_per_seq - 1)
+    def _finalize():
+        l = l_scratch[...]
+        safe_l = jnp.where(l == 0.0, 1.0, l)  # lens >= 1, but never NaN anyway
+        o_ref[0, 0, ...] = (acc_scratch[...] / safe_l).astype(o_ref.dtype)
+
+
+def _drop_scale_refs(kernel):
+    """Adapter for the unquantized layout: same kernel, no scale operands."""
+
+    @functools.wraps(kernel)
+    def wrapped(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref, *scratch):
+        return kernel(
+            tables_ref, lens_ref, q_ref, k_ref, v_ref, None, None, o_ref, *scratch
+        )
+
+    return wrapped
+
+
+def paged_attention_pallas(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    context_lens: jnp.ndarray,
+    *,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused kernel: grid ``(B, Hkv, max_blocks)``, block table scalar-prefetched
+    so each step's BlockSpec index map selects the physical block to DMA —
+    the gather never materializes ``[B, S, Hkv, D]`` in HBM, and int8 blocks
+    dequantize in-register via score/prob scale folding.
+    """
+    B, H, D = q.shape
+    NB, BS, Hkv, _ = k_pool.shape
+    MB = block_tables.shape[1]
+    rep = H // Hkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    quant = k_scale is not None
+
+    qg = _group_query_heads(q, Hkv)  # [B, Hkv, rep, D]
+    kernel = functools.partial(
+        _paged_kernel, block_size=BS, num_blocks_per_seq=MB, scale=scale
+    )
+    if not quant:
+        kernel = _drop_scale_refs(kernel)
+
+    # index maps receive (*grid, *scalar_prefetch_refs)
+    q_spec = pl.BlockSpec((1, 1, rep, D), lambda b, h, j, t, n: (b, h, 0, 0))
+    kv_spec = pl.BlockSpec((1, BS, 1, D), lambda b, h, j, t, n: (t[b, j], 0, h, 0))
+    in_specs = [q_spec, kv_spec, kv_spec]
+    inputs = [qg, k_pool, v_pool]
+    if quant:
+        sc_spec = pl.BlockSpec((1, BS, 1), lambda b, h, j, t, n: (t[b, j], 0, h))
+        in_specs += [sc_spec, sc_spec]
+        inputs += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv, MB),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, rep, D), lambda b, h, j, t, n: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, rep, D), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32), *inputs)
+    return out.reshape(B, H, D)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    context_lens: jnp.ndarray,
+    *,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """Dispatch: ``impl`` in {"auto", "pallas", "xla"}.
+
+    "auto" picks the kernel on a single-device TPU backend and the XLA
+    gather path everywhere else — a Mosaic kernel cannot be auto-partitioned
+    by XLA SPMD, and on CPU interpret mode would only emulate it (the XLA
+    path IS the CPU-native implementation; the kernel still runs under
+    ``interpret=True`` in tests to prove parity).
+    """
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" and jax.device_count() == 1 else "xla"
+    if impl == "pallas":
+        return paged_attention_pallas(
+            q, k_pool, v_pool, block_tables, context_lens,
+            k_scale=k_scale, v_scale=v_scale, scale=scale,
+            interpret=jax.default_backend() == "cpu",
+        )
+    if impl == "xla":
+        return paged_attention_xla(
+            q, k_pool, v_pool, block_tables, context_lens,
+            k_scale=k_scale, v_scale=v_scale, scale=scale,
+        )
+    raise ValueError(f"unknown paged attention impl {impl!r}")
+
+
+def write_paged_kv(
+    cache: dict, k_new: jnp.ndarray, v_new: jnp.ndarray
+) -> dict:
+    """Write one token's K/V per slot into the block pool.
+
+    ``cache`` is one layer's paged cache: pools plus the shared
+    ``block_tables`` / ``context_lens`` (lens here = tokens already present,
+    i.e. the write position of the incoming token). ``k_new``/``v_new`` are
+    ``[B, Hkv, D]``. Quantizes when the layer carries scale pools (same
+    per-row scheme as the contiguous cache: ``quantize_kv_rows``).
+
+    Distinct live slots always write distinct physical slots (the allocator
+    never lets a write frontier sit in a shared block); idle slots all write
+    the reserved null block 0, whose contents are never read as valid.
+    """
+    from trlx_tpu.models.transformer import quantize_kv_rows
+
+    k_pool = cache["k"]
+    NB, BS, Hkv, D = k_pool.shape
+    lens = cache["context_lens"]
+    bt = cache["block_tables"]
+    block = jnp.take_along_axis(bt, (lens // BS)[:, None], axis=1)[:, 0]
+    slot = block * BS + lens % BS  # [B] flat row in the (NB*BS) pool
+
+    def scatter(pool, rows):
+        flat = pool.reshape(NB * BS, *pool.shape[2:])
+        return flat.at[slot].set(rows.astype(pool.dtype)).reshape(pool.shape)
+
+    out = dict(cache)
+    if "k_scale" in cache:
+        kq, ks = quantize_kv_rows(k_new)
+        vq, vs = quantize_kv_rows(v_new)
+        out["k"] = scatter(cache["k"], kq)
+        out["v"] = scatter(cache["v"], vq)
+        out["k_scale"] = scatter(cache["k_scale"], ks[..., 0])
+        out["v_scale"] = scatter(cache["v_scale"], vs[..., 0])
+    else:
+        out["k"] = scatter(cache["k"], k_new)
+        out["v"] = scatter(cache["v"], v_new)
+    return out
+
+
+def paged_pool_layout(
+    num_blocks: int, block_size: int, kv_heads: int, dim_per_head: int,
+    dtype, quant: bool,
+) -> dict:
+    """Per-layer pool buffers as ``{key: (shape, dtype)}`` (mirror of the
+    contiguous ``kv_cache_layout``)."""
+    shape = (num_blocks, block_size, kv_heads, dim_per_head)
+    if quant:
+        return {
+            "k": (shape, jnp.int8), "v": (shape, jnp.int8),
+            "k_scale": (shape[:-1], jnp.float32),
+            "v_scale": (shape[:-1], jnp.float32),
+        }
+    return {"k": (shape, dtype), "v": (shape, dtype)}
+
+
+# -- AOT audit surface (graftcheck-ir) ----------------------------------------
+
+
+@register_entrypoint("paged_decode_step", specs=("small",))
+def build_paged_decode_step(spec: str, mesh) -> EntryArtifacts:
+    """The serving engine's steady-state decode step as graftcheck-ir audits
+    it: one token per slot through ``TransformerLM.paged_decode`` (paged-KV
+    write + paged attention per layer) followed by the pinned sampling
+    pipeline (:data:`trlx_tpu.ops.sampling.AUDIT_GEN_KWARGS`) — the jitted
+    callable :class:`trlx_tpu.serving.engine.ServingEngine` runs every step.
+
+    Audited with the XLA gather path (the deviceless CPU lowering cannot
+    build a Mosaic artifact, and under the multi-device audit mesh the
+    dispatch picks XLA anyway), int8-KV layout — the bandwidth-bound
+    configuration the engine targets.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from trlx_tpu.models.presets import PRESETS
+    from trlx_tpu.models.transformer import TransformerLM
+    from trlx_tpu.ops.sampling import AUDIT_GEN_KWARGS, sample_token
+    from trlx_tpu.parallel.mesh import BATCH_AXES
+    from trlx_tpu.parallel.sharding import make_param_shardings
+
+    dims = dict(hidden=64, layers=2, heads=4, vocab=256, B=8,
+                num_blocks=24, block_size=8, max_blocks=4)
+    model_config = PRESETS["gpt2"].replace(
+        vocab_size=dims["vocab"], hidden_size=dims["hidden"],
+        num_layers=dims["layers"], num_heads=dims["heads"],
+        intermediate_size=4 * dims["hidden"], max_position_embeddings=1024,
+        param_dtype=jnp.float32, compute_dtype=jnp.bfloat16,
+        kv_cache_quant=True,
+    )
+    trunk = TransformerLM(model_config)
+
+    params_shape = jax.eval_shape(
+        lambda: trunk.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 2), jnp.int32), jnp.ones((1, 2), jnp.int32)
+        )
+    )["params"]
+    abs_params = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+        params_shape, make_param_shardings(params_shape, mesh),
+    )
+
+    B = dims["B"]
+    NB, BS, MB = dims["num_blocks"], dims["block_size"], dims["max_blocks"]
+    kvh, dph = model_config.kv_heads, model_config.dim_per_head
+    repl = NamedSharding(mesh, PartitionSpec())
+    bsh = NamedSharding(mesh, PartitionSpec(BATCH_AXES))
+    layout = paged_pool_layout(NB, BS, kvh, dph, model_config.compute_dtype, True)
+    abs_cache = {
+        key: [jax.ShapeDtypeStruct(shp, dt, sharding=repl)
+              for _ in range(dims["layers"])]
+        for key, (shp, dt) in layout.items()
+    }
+    abs_cache["block_tables"] = jax.ShapeDtypeStruct((B, MB), jnp.int32, sharding=bsh)
+    abs_cache["context_lens"] = jax.ShapeDtypeStruct((B,), jnp.int32, sharding=bsh)
+    abs_tok = jax.ShapeDtypeStruct((B,), jnp.int32, sharding=bsh)
+    abs_rng = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+
+    def decode_fn(params, tok, cache, rng):
+        logits, _, new_cache = trunk.apply(
+            {"params": params}, tok[:, None], cache, method=trunk.paged_decode
+        )
+        next_tok = sample_token(rng, logits[:, -1, :], **AUDIT_GEN_KWARGS)
+        return next_tok, new_cache
+
+    # output cache shardings must equal the input's for the donated pool
+    # buffers to alias (IR002); leaving them to inference breaks the aliasing
+    cache_out_shardings = jax.tree.map(lambda _: repl, abs_cache)
+    cache_out_shardings["block_tables"] = bsh
+    cache_out_shardings["context_lens"] = bsh
+
+    return EntryArtifacts(
+        fn=decode_fn,
+        args=(abs_params, abs_tok, abs_cache, abs_rng),
+        donate_argnums=(2,),
+        out_shardings=(bsh, cache_out_shardings),
+        compute_dtype="bfloat16",
+        # the paged-attention reference accumulates scores and probs@V in f32
+        # (preferred_element_type, flash-kernel algebra): 2 dots/layer
+        f32_allow=frozenset({"dot_general:4"}),
+        meta=dict(batch=B, num_blocks=NB, block_size=BS,
+                  hidden_size=dims["hidden"], num_layers=dims["layers"]),
+    )

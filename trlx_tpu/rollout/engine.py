@@ -37,7 +37,7 @@ Coordination rules (enforced here, relied on by the trainer):
 import contextlib
 import threading
 import time
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional
 
 from trlx_tpu.obs import span, watchdog
 from trlx_tpu.resilience.chaos import chaos
@@ -51,6 +51,48 @@ logger = logging.get_logger(__name__)
 
 #: Watchdog heartbeat name for the producer thread (docs/observability.md).
 PRODUCER_HEARTBEAT = "rollout-producer"
+
+
+def length_bucketed(batches: Iterator[Dict[str, list]], lookahead: int) -> Iterator[Dict[str, list]]:
+    """Reorder a prompt-batch stream so each batch holds similar-length prompts.
+
+    The one-shot generate path pads every batch to the length bucket of its
+    longest prompt, so one straggler makes the whole batch pay its prefill and
+    per-token attention cost. This wrapper pulls a window of ``lookahead``
+    batches, stable-sorts the window's prompts by token length, and re-chunks
+    them into batches of the original sizes — tight buckets without changing
+    the set of prompts drawn (the serving engine's admission rounds do the
+    same sort slot-by-slot; this is the cheap precursor for the generate path).
+
+    Deterministic and replay-safe: the reorder is a pure function of the
+    incoming window, and k batches in -> k batches out, so the auto-resume
+    fast-forward (which counts batches drawn) lands on the same stream
+    position. ``lookahead <= 1`` yields the stream unchanged.
+    """
+    if lookahead <= 1:
+        yield from batches
+        return
+    batches = iter(batches)
+    while True:
+        window = []
+        try:
+            for _ in range(lookahead):
+                window.append(next(batches))
+        except StopIteration:
+            pass
+        if not window:
+            return
+        sizes = [len(b["input_ids"]) for b in window]
+        keys = list(window[0].keys())
+        flat = {k: [v for b in window for v in b[k]] for k in keys}
+        order = sorted(range(len(flat["input_ids"])), key=lambda i: len(flat["input_ids"][i]))
+        start = 0
+        for size in sizes:
+            idx = order[start:start + size]
+            start += size
+            yield {k: [flat[k][i] for i in idx] for k in keys}
+        if len(window) < lookahead:  # underlying stream ended mid-window
+            return
 
 
 class AsyncRolloutEngine:

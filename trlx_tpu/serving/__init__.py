@@ -1,0 +1,23 @@
+"""Continuous-batching generation server (paged KV cache + in-flight
+batching + prefix sharing). See docs/serving.md.
+
+- :mod:`allocator` — ref-counted paged block allocator with a prefix cache
+- :mod:`scheduler` — request queue, decode slots, finish detection
+- :mod:`engine` — the device loop: bucketed prefill + fixed-shape paged
+  decode step (``trlx_tpu/ops/paged_attention.py``)
+- :mod:`client` — GenerationClient: rollout drop-in + submit/stream/cancel
+"""
+
+from trlx_tpu.serving.allocator import PagedBlockAllocator, SeqBlocks
+from trlx_tpu.serving.client import GenerationClient
+from trlx_tpu.serving.engine import ServingEngine
+from trlx_tpu.serving.scheduler import InflightScheduler, Request
+
+__all__ = [
+    "PagedBlockAllocator",
+    "SeqBlocks",
+    "GenerationClient",
+    "ServingEngine",
+    "InflightScheduler",
+    "Request",
+]

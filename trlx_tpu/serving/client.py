@@ -1,0 +1,111 @@
+"""GenerationClient: the seam between rollout production and the engine.
+
+Two consumption styles over one :class:`ServingEngine`:
+
+- :meth:`generate_batch` — the rollout path. Takes a ragged batch of prompt
+  arrays and returns ``(sequences [B, P+N], response_mask [B, N], P)`` in the
+  exact shape/semantics contract of ``MeshRLTrainer.generate`` (left-padded
+  prompts to the shared length bucket, pad after eos, mask 1 on generated
+  tokens up to and including eos), so ``decode``/scoring/quarantine downstream
+  are untouched when ``train.serving`` is enabled.
+- :meth:`submit` / :meth:`stream` / :meth:`cancel` — the request API for
+  non-rollout sampling traffic: tokens stream out as the engine decodes them,
+  and a cancelled request releases its blocks on the next admission round.
+
+The client serializes engine stepping: concurrent ``generate_batch`` /
+``stream`` callers interleave their requests into the same continuous batch
+(that is the point), with one caller driving the device at a time.
+"""
+
+import threading
+from typing import Dict, Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+from trlx_tpu.ops.generation import pad_to_bucket
+from trlx_tpu.serving.engine import PREFILL_LEN_BUCKETS, ServingEngine
+from trlx_tpu.serving.scheduler import Request
+
+
+class GenerationClient:
+    def __init__(self, engine: ServingEngine):
+        self.engine = engine
+        self._step_lock = threading.Lock()
+
+    # -- request API ---------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        stop_sequences: Sequence[Sequence[int]] = (),
+    ) -> int:
+        return self.engine.submit(prompt, max_new_tokens, stop_sequences=stop_sequences)
+
+    def cancel(self, uid: int) -> bool:
+        return self.engine.cancel(uid)
+
+    def _request(self, uid: int) -> Request:
+        req = self.engine.scheduler.requests.get(uid)
+        if req is None:
+            raise KeyError(f"unknown request uid {uid}")
+        return req
+
+    def stream(self, uid: int) -> Iterator[int]:
+        """Yield the request's tokens as the engine produces them, driving
+        engine rounds while the request is live. Tokens already decoded when
+        the iterator starts are yielded immediately."""
+        req = self._request(uid)
+        sent = 0
+        while True:
+            gen = req.generated
+            while sent < len(gen):
+                yield gen[sent]
+                sent += 1
+            if req.done:
+                break
+            with self._step_lock:
+                if not req.done:
+                    self.engine.step()
+        for tok in req.generated[sent:]:
+            yield tok
+
+    # -- rollout path --------------------------------------------------------
+
+    def generate_batch(
+        self,
+        prompts: List[np.ndarray],
+        max_new_tokens: int,
+        stop_sequences: Sequence[Sequence[int]] = (),
+    ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """Continuous-batched drop-in for the one-shot generate path.
+
+        Returns ``(sequences [B, P+N], response_mask [B, N], P)`` with P the
+        shared prompt bucket: prompts left-padded, responses padded with
+        ``pad_token_id`` after finish, mask 1 on every generated token up to
+        and including eos (``ops/generation.generate`` semantics — eos/stop
+        trimming stays the consumer's job, exactly as ``decode`` expects)."""
+        engine = self.engine
+        N = int(max_new_tokens)
+        P = pad_to_bucket(max((len(p) for p in prompts), default=1), PREFILL_LEN_BUCKETS)
+        with self._step_lock:
+            uids = [
+                engine.submit(np.asarray(p).tolist(), N, stop_sequences=stop_sequences)
+                for p in prompts
+            ]
+            done = engine.run(uids)
+        B = len(prompts)
+        seqs = np.full((B, P + N), engine.pad_token_id, np.int32)
+        mask = np.zeros((B, N), np.int32)
+        for i, (uid, p) in enumerate(zip(uids, prompts)):
+            req = done[uid]
+            engine.scheduler.requests.pop(uid, None)
+            p = np.asarray(p, np.int32)
+            gen = np.asarray(req.generated, np.int32)
+            seqs[i, P - len(p):P] = p
+            seqs[i, P:P + len(gen)] = gen
+            mask[i, : len(gen)] = 1
+        return seqs, mask, P
+
+    def summary(self) -> Dict[str, float]:
+        return self.engine.summary()

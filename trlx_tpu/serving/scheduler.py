@@ -1,0 +1,207 @@
+"""In-flight batching scheduler: requests, decode slots, finish detection.
+
+The engine's device step always runs the full ``num_slots`` batch; this
+module decides *what occupies each slot*. A slot frees the moment its
+sequence finishes (eos, stop-sequence, max-new-tokens, or cancel) and the
+next pending request is admitted into it on the following admission round —
+continuous batching, as opposed to the one-shot ``generate`` path that pads
+every sequence to the longest straggler in its batch.
+
+Admission is capacity-gated by the :class:`PagedBlockAllocator`: a request is
+only placed when its worst-case block reservation (prompt + max_new) fits,
+so a live sequence can never hit an allocation failure mid-flight. Pending
+requests are sorted by prompt length at each round so one admission wave
+prefills in a few tight buckets instead of one ragged batch.
+"""
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from trlx_tpu.serving.allocator import PagedBlockAllocator, SeqBlocks
+
+FINISH_EOS = "eos"
+FINISH_STOP = "stop_sequence"
+FINISH_LENGTH = "length"
+FINISH_CANCELLED = "cancelled"
+
+
+@dataclass
+class Request:
+    """One generation request. ``prompt`` is token ids (no padding)."""
+
+    uid: int
+    prompt: List[int]
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    stop_sequences: Tuple[Tuple[int, ...], ...] = ()
+    # -- filled in by the scheduler/engine --
+    generated: List[int] = field(default_factory=list)
+    finish_reason: Optional[str] = None
+    seq_blocks: Optional[SeqBlocks] = None
+    slot: Optional[int] = None
+
+    @property
+    def done(self) -> bool:
+        return self.finish_reason is not None
+
+
+class InflightScheduler:
+    def __init__(self, num_slots: int, allocator: PagedBlockAllocator):
+        self.num_slots = num_slots
+        self.allocator = allocator
+        self._uid = itertools.count()
+        self._lock = threading.Lock()
+        self._pending: List[Request] = []
+        self._cancelled: set = set()
+        self.slots: List[Optional[Request]] = [None] * num_slots
+        self.finished: Dict[int, Request] = {}
+        # uid -> Request for every request ever submitted (stream/cancel
+        # lookups); entries are dropped when the consumer collects them
+        self.requests: Dict[int, Request] = {}
+        # occupancy accounting for the obs gauge: live slots integrated over steps
+        self.steps = 0
+        self.occupied_slot_steps = 0
+
+    # -- request intake (thread-safe: rollout producers submit from their own
+    # threads while the engine loop drains) --------------------------------
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        eos_token_id: Optional[int] = None,
+        stop_sequences: Sequence[Sequence[int]] = (),
+    ) -> int:
+        req = Request(
+            uid=next(self._uid),
+            prompt=list(map(int, prompt)),
+            max_new_tokens=int(max_new_tokens),
+            eos_token_id=eos_token_id,
+            stop_sequences=tuple(tuple(map(int, s)) for s in stop_sequences if len(s)),
+        )
+        with self._lock:
+            self._pending.append(req)
+            self.requests[req.uid] = req
+        return req.uid
+
+    def cancel(self, uid: int) -> bool:
+        """Cancel a pending or in-flight request. In-flight sequences are
+        reaped (blocks freed) on the next admission round."""
+        with self._lock:
+            for i, req in enumerate(self._pending):
+                if req.uid == uid:
+                    self._pending.pop(i)
+                    req.finish_reason = FINISH_CANCELLED
+                    self.finished[uid] = req
+                    return True
+            self._cancelled.add(uid)
+        # racy-but-benign read of engine-thread state: a request placed
+        # concurrently is still reaped next round via _cancelled
+        return any(r is not None and r.uid == uid for r in self.slots)
+
+    @property
+    def has_work(self) -> bool:
+        with self._lock:
+            pending = bool(self._pending)
+        return pending or any(r is not None for r in self.slots)
+
+    @property
+    def live_slots(self) -> int:
+        return sum(r is not None for r in self.slots)
+
+    def pop_finished(self) -> Dict[int, Request]:
+        # locked against a producer-thread cancel() landing a pending request
+        # in `finished` between the read and the reset
+        with self._lock:
+            out, self.finished = self.finished, {}
+        return out
+
+    # -- engine-side rounds --------------------------------------------------
+
+    def _finish(self, slot: int, reason: str) -> Request:
+        req = self.slots[slot]
+        self.slots[slot] = None
+        req.finish_reason = reason
+        if req.seq_blocks is not None:
+            self.allocator.free(req.seq_blocks)
+            req.seq_blocks = None
+        req.slot = None
+        with self._lock:  # `finished` is also written by producer-side cancel()
+            self.finished[req.uid] = req
+        return req
+
+    def reap_cancelled(self) -> List[int]:
+        """Free slots whose requests were cancelled mid-flight. Returns the
+        freed slot indices (the engine zeroes their device state)."""
+        freed = []
+        with self._lock:
+            cancelled, self._cancelled = self._cancelled, set()
+        for slot, req in enumerate(self.slots):
+            if req is not None and req.uid in cancelled:
+                self._finish(slot, FINISH_CANCELLED)
+                freed.append(slot)
+        return freed
+
+    def admissions(self) -> List[Tuple[int, Request]]:
+        """Fill free slots from the pending queue, shortest prompts first
+        (so each admission wave prefills in tight length buckets). Returns
+        ``(slot, request)`` placements; the engine runs the prefills and
+        block-table updates. Requests that don't fit block capacity stay
+        pending."""
+        free = [i for i, r in enumerate(self.slots) if r is None]
+        if not free:
+            return []
+        # snapshot the pending queue under the lock, then place outside it:
+        # allocation and slot assignment are engine-thread state and must not
+        # sit in the producer-facing critical section
+        with self._lock:
+            pending, self._pending = self._pending, []
+        pending.sort(key=lambda r: len(r.prompt))
+        placements: List[Tuple[int, Request]] = []
+        kept: List[Request] = []
+        for req in pending:
+            if not free:
+                kept.append(req)
+                continue
+            seq = self.allocator.allocate(
+                req.prompt, len(req.prompt) + req.max_new_tokens
+            )
+            if seq is None:
+                kept.append(req)  # capacity-blocked; retry next round
+                continue
+            req.seq_blocks = seq
+            slot = free.pop(0)
+            req.slot = slot
+            self.slots[slot] = req
+            placements.append((slot, req))
+        if kept:
+            with self._lock:  # ahead of anything submitted while we placed
+                self._pending = kept + self._pending
+        return placements
+
+    def on_token(self, slot: int, token: int) -> Optional[Request]:
+        """Record one decoded token for a live slot; returns the request when
+        this token finished it (the token IS kept — eos/stop trimming is the
+        consumer's contract, matching ``ops/generation.generate``)."""
+        req = self.slots[slot]
+        if req is None:
+            return None
+        req.generated.append(int(token))
+        if req.eos_token_id is not None and token == req.eos_token_id:
+            return self._finish(slot, FINISH_EOS)
+        for stop in req.stop_sequences:
+            if len(req.generated) >= len(stop) and tuple(req.generated[-len(stop):]) == stop:
+                return self._finish(slot, FINISH_STOP)
+        if len(req.generated) >= req.max_new_tokens:
+            return self._finish(slot, FINISH_LENGTH)
+        return None
+
+    def note_step(self) -> None:
+        self.steps += 1
+        self.occupied_slot_steps += self.live_slots
+
+    @property
+    def mean_slot_occupancy(self) -> float:
+        return self.occupied_slot_steps / max(1, self.steps) / max(1, self.num_slots)

@@ -72,6 +72,16 @@ class PPOTrainer(MeshRLTrainer):
         self._resume_prompt_batches = 0
         self._prompt_pipeline = None
 
+        # continuous-batching serving engine (trlx_tpu/serving; resolved in
+        # prepare_learning). None = the one-shot generate path. When set,
+        # _generate_chunks routes generation through the GenerationClient;
+        # decode/reward/scoring/quarantine downstream are identical.
+        self._serving_client = None
+        self._serving_engine = None
+        self._serving_max_new = 0
+        self._serving_min_new = 0
+        self._serving_param_ref = None
+
         # experience quarantine (trlx_tpu/resilience/quarantine): screens
         # every assembled PPORLElement when self-healing is on; None = the
         # historical trust-everything behavior
@@ -375,7 +385,13 @@ class PPOTrainer(MeshRLTrainer):
         # and replay draws to the restored position (an iterator can't rewind)
         self._prompt_pipeline = pipeline
         loader = pipeline.create_loader(batch, shuffle=True, seed=self.config.train.seed)
-        self.prompt_iterator = infinite_loader(loader)
+        stream = infinite_loader(loader)
+        lookahead = self.config.train.async_rollouts.length_bucket_lookahead
+        if lookahead > 1:
+            from trlx_tpu.rollout.engine import length_bucketed
+
+            stream = length_bucketed(stream, lookahead)
+        self.prompt_iterator = stream
 
     def setup_rollout_logging(self, config):
         import os
@@ -478,6 +494,95 @@ class PPOTrainer(MeshRLTrainer):
         )
         return self._score_fns[key]
 
+    # ------------------------------------------------------------- serving
+
+    def _resolve_serving(self):
+        """Build the continuous-batching GenerationClient when
+        ``train.serving.enabled`` and the run shape supports it; otherwise
+        log why and keep the one-shot generate path (``_serving_client``
+        stays None). Called once from prepare_learning."""
+        cfg = self.config.train.serving
+        if not cfg.enabled or self._serving_client is not None:
+            return
+
+        def fallback(reason):
+            logger.warning(f"train.serving disabled for this run: {reason}")
+
+        if self.is_seq2seq:
+            return fallback("seq2seq generation is not paged")
+        if self.model_config.stacked:
+            return fallback("stacked/pipelined layouts keep the contiguous cache")
+        if self.model_config.peft_type in ("prompt", "prefix"):
+            return fallback("prompt/prefix peft puts virtual rows in the cache")
+        if self.mesh is not None and self.mesh.size > 1:
+            return fallback("multi-device mesh (the paged step is single-device)")
+        if self.gen_logits_processor() is not None:
+            return fallback("decode-time logits processor in use")
+
+        from trlx_tpu.models.transformer import TransformerLM
+        from trlx_tpu.serving import GenerationClient, ServingEngine
+
+        gen_kwargs = dict(self.generate_experience_kwargs or self.generate_kwargs)
+        gen_kwargs.setdefault("eos_token_id", self.tokenizer.eos_token_id)
+        gen_kwargs.setdefault("pad_token_id", self.tokenizer.pad_token_id)
+        self._serving_max_new = int(gen_kwargs.pop("max_new_tokens", 16))
+        self._serving_min_new = int(gen_kwargs.pop("min_new_tokens", 0))
+        eos = gen_kwargs.pop("eos_token_id")
+        pad = gen_kwargs.pop("pad_token_id")
+        sample_keys = ("temperature", "top_k", "top_p", "do_sample", "top_k_impl")
+        unknown = set(gen_kwargs) - set(sample_keys)
+        if unknown:
+            return fallback(f"unsupported gen_kwargs for the serving engine: {sorted(unknown)}")
+
+        trunk_config = self.model_config.replace(
+            kv_cache_quant=(
+                self.model_config.kv_cache_quant
+                if cfg.kv_cache_quant is None else bool(cfg.kv_cache_quant)
+            ),
+            paged_attention_impl=cfg.attention_impl,
+        )
+        num_slots = cfg.num_slots or (
+            self.method.decode_batch_size or self.method.chunk_size
+        )
+        # prompts are admitted unpadded, so capacity only needs the real
+        # prompt lengths (<= seq_length) plus the decode budget
+        max_seq_len = self.config.train.seq_length + self._serving_max_new
+        self._serving_engine = ServingEngine(
+            TransformerLM(trunk_config),
+            None,  # snapshot installed per rollout phase in _serving_generate
+            num_slots=num_slots,
+            max_seq_len=max_seq_len,
+            block_size=cfg.block_size,
+            num_blocks=cfg.num_blocks,
+            eos_token_id=eos,
+            pad_token_id=pad,
+            gen_kwargs=gen_kwargs,
+            min_new_tokens=self._serving_min_new,
+            prefix_caching=cfg.prefix_caching,
+            seed=self.config.train.seed + 17,
+        )
+        self._serving_client = GenerationClient(self._serving_engine)
+        logger.info(
+            f"serving engine enabled: slots={num_slots}, "
+            f"block_size={cfg.block_size}, blocks={self._serving_engine.num_blocks}, "
+            f"int8_kv={trunk_config.kv_cache_quant}, impl={cfg.attention_impl}"
+        )
+
+    def _serving_generate(self, prompts, params=None):
+        """Continuous-batched replacement for ``self.generate`` in the rollout
+        producer: same ``(sequences, response_mask, pad_len)`` contract. The
+        engine flushes its prefix cache whenever the parameter snapshot
+        object changes (each publish / rollout-copy recast is a new tree)."""
+        gen_params = params if params is not None else self.generation_params()
+        tparams = gen_params["transformer"]
+        if tparams is not self._serving_param_ref:
+            self._serving_engine.set_params(tparams)
+            self._serving_param_ref = tparams
+        with self.obs.span("generate"):
+            return self._serving_client.generate_batch(prompts, self._serving_max_new)
+
+    # ------------------------------------------------------------- experience
+
     def _generate_chunks(self, tokenizer, params=None):
         """One device generation at decode_batch_size, split into chunk_size
         sub-chunks for reward_fn / the scoring forward. ``params`` overrides
@@ -486,7 +591,10 @@ class PPOTrainer(MeshRLTrainer):
         self._prompt_batches_drawn += 1
         prompts = batch["input_ids"]
         metadata = {k: v for k, v in batch.items() if k != "input_ids"}
-        samples, resp_mask, pad_len = self.generate(prompts, eval_mode=False, params=params)
+        if self._serving_client is not None:
+            samples, resp_mask, pad_len = self._serving_generate(prompts, params=params)
+        else:
+            samples, resp_mask, pad_len = self.generate(prompts, eval_mode=False, params=params)
         str_samples, str_prompts, str_outputs, out_ids = self.decode(
             prompts, samples, pad_len, append_eos=True, response_masks=resp_mask
         )
@@ -856,6 +964,7 @@ class PPOTrainer(MeshRLTrainer):
         bs = self.config.train.batch_size
         self.num_mb = max(1, bs // (self.config.train.minibatch_size or bs))
         self._fast_forward_prompt_stream()
+        self._resolve_serving()
         self._async_cfg = self._resolve_async_config()
         if self._async_cfg is not None:
             self._start_async_engine()
@@ -960,6 +1069,8 @@ class PPOTrainer(MeshRLTrainer):
         out.update(self.rollout_stats)
         if self._engine is not None:
             out.update(gauges.snapshot("rollout/"))
+        if self._serving_client is not None:
+            out.update(gauges.snapshot("serving/"))
         return out
 
     def post_backward_callback(self):
