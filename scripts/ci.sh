@@ -15,10 +15,25 @@ echo "== graftcheck (python -m trlx_tpu.analysis)"
 # semantic gate: JAX RNG/tracing discipline, thread/lock discipline, and the
 # SPMD program checks — collective axis names, donation hazards, mixed
 # precision, PartitionSpec sanity (JX005-JX008, docs/static-analysis.md).
-# One invocation covers every registered rule over the repo-wide call graph;
-# hard-fails on any finding that is neither noqa'd at the line nor justified
-# in graftcheck-baseline.txt
-JAX_PLATFORMS=cpu python -m trlx_tpu.analysis trlx_tpu tests examples scripts bench.py __graft_entry__.py
+# One invocation covers every registered rule (including the interprocedural
+# concurrency pass, CC001-CC005) over the repo-wide call graph; hard-fails on
+# any finding that is neither noqa'd at the line nor justified in
+# graftcheck-baseline.txt. --jobs fans per-file checks over a fork pool,
+# clamped to the core count (serial on 1-core runners)
+JAX_PLATFORMS=cpu python -m trlx_tpu.analysis trlx_tpu tests examples scripts bench.py __graft_entry__.py --jobs 4
+
+echo "== graftcheck-conc gate (must fail on the seeded race)"
+# the conc gate proves itself: the same command that must pass on the clean
+# tree must exit 1 when TRLX_CONC_SEED_REGRESSION re-introduces the PR-8
+# scheduler race in memory — a gate that cannot catch the bug it was built
+# for is not a gate (mirrors TRLX_IR_SEED_REGRESSION below)
+JAX_PLATFORMS=cpu python -m trlx_tpu.analysis trlx_tpu tests examples scripts bench.py __graft_entry__.py --select CC
+if JAX_PLATFORMS=cpu TRLX_CONC_SEED_REGRESSION=scheduler_race \
+    python -m trlx_tpu.analysis trlx_tpu tests examples scripts bench.py __graft_entry__.py --select CC > /dev/null 2>&1; then
+    echo "FATAL: seeded scheduler_race regression was NOT caught by the CC gate" >&2
+    exit 1
+fi
+echo "seeded scheduler_race correctly rejected"
 
 echo "== tests"
 if [[ "${1:-}" == "--slow" ]]; then
@@ -52,6 +67,13 @@ echo "== analysis tests (CPU)"
 # bounded like the others so a runaway fixture scan fails fast
 JAX_PLATFORMS=cpu timeout -k 10 300 \
     python -m pytest tests/test_analysis.py -q -m "not slow" -p no:cacheprovider
+
+echo "== analysis-conc tests (CPU)"
+# the concurrency analyzer's own suite: CC001-CC005 positives/negatives,
+# thread-root modeling (Thread targets, escalation callbacks, closures),
+# noqa/baseline round-trips, --jobs parity, the seeded-regression path
+JAX_PLATFORMS=cpu timeout -k 10 300 \
+    python -m pytest tests/test_analysis_conc.py -q -m "not slow" -p no:cacheprovider
 
 echo "== analysis-ir tests (CPU)"
 # graftcheck-ir's own suite: entrypoint registry, IR001-IR004 on tiny inline

@@ -5,8 +5,10 @@
 #
 # BASE defaults to HEAD: staged + unstaged + untracked changes are checked.
 # Pass a ref (e.g. main) to check everything that differs from that ref.
-# --select RULES (comma-separated, e.g. --select JX005,JX008) is passed
-# through to graftcheck to run one rule family while iterating on a fix.
+# --select RULES (comma-separated, e.g. --select JX005,JX008 — a prefix like
+# CC selects the whole family) is passed through to graftcheck to run one
+# rule family while iterating on a fix; without it every registered rule
+# (JX/TH/CC) runs on the changed files.
 # Full-tree equivalents run in scripts/ci.sh; this is the seconds-fast loop.
 set -euo pipefail
 cd "$(dirname "$0")/.."

@@ -361,6 +361,8 @@ def test_jx004_defaulted_params_are_static(tmp_path):
 
 
 def test_th001_unlocked_read(tmp_path):
+    # scoped to TH001: these lock-owning fixtures legitimately trip CC001
+    # too (test_analysis_conc.py owns that surface)
     findings = check_snippet(
         tmp_path,
         """
@@ -378,6 +380,7 @@ def test_th001_unlocked_read(tmp_path):
             def peek(self):
                 return self._count
         """,
+        select=["TH001"],
     )
     assert rule_ids(findings) == ["TH001"]
     assert "peek" in findings[0].message
@@ -402,6 +405,7 @@ def test_th001_container_mutation_counts_as_write(tmp_path):
                 out = list(self._items)
                 return out
         """,
+        select=["TH001"],
     )
     assert rule_ids(findings) == ["TH001"]
 
@@ -447,6 +451,7 @@ def test_th001_unguarded_attrs_do_not_flag(tmp_path):
             def get_mode(self):
                 return self.mode
         """,
+        select=["TH001"],
     )
     assert findings == []
 

@@ -23,6 +23,17 @@ JX008   PartitionSpec with unknown/duplicate axes, or a rank that
         drifts from parallel/sharding.py's rule table
 TH001   lock-guarded attribute accessed without the lock elsewhere
 TH002   threading.Thread with neither daemon= nor a reachable join()
+CC001   attribute shared across thread roles (spawned threads, escalation
+        callbacks, multi-threaded public API) with an empty lockset
+        intersection — interprocedural, lifts TH001's lexical limit
+CC002   cycle in the lock-order graph (deadlock), edges propagated
+        through call-graph acquired-lock summaries
+CC003   condition-variable protocol: bare wait() outside a predicate
+        loop, ignored wait-timeout result, wait/notify without the lock
+CC004   check-then-act: lock released between a guarded read and the
+        dependent guarded write in the same method
+CC005   blocking call (queue put/get, Event.wait, Thread.join,
+        device_get/block_until_ready, file I/O) while holding a lock
 IR001   f32/f64 heavy op inside a bf16-declared compiled step
 IR002   declared donation the compiled module does not alias (or a
         donat-able input never declared)
@@ -34,7 +45,12 @@ IR006   compiled memory accounting deviates from the committed budget
 
 Tracedness (JX002-JX004) is resolved over a cross-module import-aware
 call graph (:mod:`trlx_tpu.analysis.callgraph`): jitting a function
-imported from another scanned file taints that file's defs too.
+imported from another scanned file taints that file's defs too. The same
+graph also records thread entry points (``Thread(target=...)``, watchdog
+``escalate`` callbacks) — the roots the concurrency analyzer
+(:mod:`trlx_tpu.analysis.conc`, rules ``CC0xx``) propagates Eraser-style
+static locksets from. ``TRLX_CONC_SEED_REGRESSION=scheduler_race`` seeds
+the PR-8 scheduler race in memory as a must-fail gate self-test.
 
 ``IR0xx`` rules live below the AST: :mod:`trlx_tpu.analysis.ir`
 AOT-lowers the registered hot entrypoints devicelessly and audits the
@@ -57,6 +73,7 @@ from trlx_tpu.analysis.core import (  # noqa: F401
     run,
 )
 from trlx_tpu.analysis import rules_jax, rules_spmd, rules_threads  # noqa: F401
+from trlx_tpu.analysis.conc import rules_conc  # noqa: F401  (registers CC001-CC005)
 from trlx_tpu.analysis.ir import rules_ir  # noqa: F401  (registers IR001-IR006)
 
 __all__ = [

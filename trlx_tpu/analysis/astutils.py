@@ -48,6 +48,7 @@ class Aliases:
     partial: Set[str] = field(default_factory=set)
     thread_class: Set[str] = field(default_factory=set)  # `from threading import Thread`
     lock_factories: Set[str] = field(default_factory=set)  # `from threading import Lock`
+    event_class: Set[str] = field(default_factory=set)  # `from threading import Event`
 
 
 _LOCK_FACTORY_NAMES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
@@ -85,7 +86,63 @@ def collect_aliases(tree: ast.Module) -> Aliases:
                     al.thread_class.add(bound)
                 elif mod == "threading" and a.name in _LOCK_FACTORY_NAMES:
                     al.lock_factories.add(bound)
+                elif mod == "threading" and a.name == "Event":
+                    al.event_class.add(bound)
     return al
+
+
+#: Call names (last dotted component) treated as *higher-order entry points*:
+#: a function-valued argument handed to one of these runs — maybe later, maybe
+#: on another thread — so for closure purposes the reference IS a call edge.
+#: Covers the jax control-flow/transform surface (``lax.scan(body, ...)``
+#: taints ``body``) and the runtime's thread/callback spawners
+#: (``threading.Thread(target=self._loop)``, ``watchdog.escalate(name, cb)``).
+HOF_NAMES = frozenset(
+    {
+        "scan", "cond", "while_loop", "switch", "fori_loop", "map",
+        "associative_scan", "vmap", "pmap", "grad", "value_and_grad",
+        "jit", "pjit", "remat", "checkpoint", "shard_map", "partial",
+        "Thread", "Timer", "escalate",
+    }
+)
+
+
+def callable_arg_refs(call: ast.Call) -> List[ast.AST]:
+    """Function-valued references passed *into* a call: lambdas anywhere
+    (they execute as part of the call), plus Name/Attribute args when the
+    callee is a known higher-order entry point (:data:`HOF_NAMES`). Used by
+    the traced-function closures and the call graph so ``lax.scan(body)``,
+    ``Thread(target=self._x)`` and ``escalate(name, cb)`` count as calls."""
+    fn = call.func
+    last: Optional[str] = None
+    if isinstance(fn, ast.Name):
+        last = fn.id
+    elif isinstance(fn, ast.Attribute):
+        last = fn.attr
+    out: List[ast.AST] = []
+    for a in list(call.args) + [kw.value for kw in call.keywords]:
+        if isinstance(a, ast.Lambda):
+            out.append(a)
+        elif last in HOF_NAMES and isinstance(a, (ast.Name, ast.Attribute)):
+            out.append(a)
+    return out
+
+
+def _closure_callees(call: ast.Call, defs_by_name: Dict[str, List[ast.AST]]) -> List[ast.AST]:
+    """Same-file defs a call may reach: bare-name calls plus callable args to
+    higher-order entry points (``body`` in ``lax.scan(body, ...)``, ``self._x``
+    in ``Thread(target=self._x)`` — bound methods resolve by bare attr name)."""
+    out: List[ast.AST] = []
+    if isinstance(call.func, ast.Name):
+        out.extend(defs_by_name.get(call.func.id, []))
+    for ref in callable_arg_refs(call):
+        if isinstance(ref, ast.Lambda):
+            out.append(ref)
+        elif isinstance(ref, ast.Name):
+            out.extend(defs_by_name.get(ref.id, []))
+        elif isinstance(ref, ast.Attribute) and isinstance(ref.value, ast.Name) and ref.value.id == "self":
+            out.extend(defs_by_name.get(ref.attr, []))
+    return out
 
 
 def dotted(node: ast.AST) -> Optional[str]:
@@ -178,7 +235,9 @@ def traced_functions(tree: ast.Module, al: Aliases) -> Set[ast.AST]:
     - wrapped anywhere in the file: ``jax.jit(step)``, ``jax.jit(lambda ...)``;
     - called (by bare name, same file) from an already-traced body, to a
       fixpoint — ``jax.jit(step)`` taints the helper ``body`` that ``step``
-      calls, which is how "reachable inside jit" is approximated.
+      calls, which is how "reachable inside jit" is approximated. The closure
+      also follows callable *arguments* to higher-order entry points
+      (``lax.scan(body, ...)`` taints ``body``), see :func:`callable_arg_refs`.
     """
     defs_by_name: Dict[str, List[ast.AST]] = {}
     for node in ast.walk(tree):
@@ -196,14 +255,14 @@ def traced_functions(tree: ast.Module, al: Aliases) -> Set[ast.AST]:
             elif isinstance(target, ast.Name):
                 traced.update(defs_by_name.get(target.id, []))
 
-    # transitive closure over same-file bare-name calls
+    # transitive closure over same-file bare-name calls and HOF callable args
     changed = True
     while changed:
         changed = False
         for fn in list(traced):
             for node in ast.walk(fn):
-                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
-                    for callee in defs_by_name.get(node.func.id, []):
+                if isinstance(node, ast.Call):
+                    for callee in _closure_callees(node, defs_by_name):
                         if callee not in traced:
                             traced.add(callee)
                             changed = True

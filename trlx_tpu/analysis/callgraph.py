@@ -28,6 +28,19 @@ An ambiguous suffix is disambiguated package-relatively from the importing
 module (its own package's ``helpers`` beats a same-named module elsewhere);
 what remains ambiguous resolves to nothing — a missed edge only loses a
 finding, a wrong edge invents one.
+
+Beyond direct calls, two indirect call shapes are modeled as edges:
+
+- callable *arguments* to higher-order entry points
+  (:data:`astutils.HOF_NAMES`): ``lax.scan(body, ...)`` / ``lax.cond(p, t,
+  f)`` taint their function args through the same fixpoint, sharpening the
+  JX002–JX004 transitive closures;
+- thread/callback spawns — ``threading.Thread(target=self._loop)``,
+  ``threading.Timer``, and watchdog ``escalate(name, callback)``
+  registrations — collected into :attr:`Project.thread_targets` with each
+  target resolved to its def node(s). These are the entry points the
+  concurrency analyzer (:mod:`trlx_tpu.analysis.conc`) roots its thread-role
+  and lockset propagation at.
 """
 
 import ast
@@ -35,7 +48,22 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from trlx_tpu.analysis import astutils
-from trlx_tpu.analysis.astutils import Aliases, collect_aliases, dotted
+from trlx_tpu.analysis.astutils import Aliases, callable_arg_refs, collect_aliases, dotted
+
+
+@dataclass
+class ThreadTarget:
+    """One discovered thread entry point: a ``threading.Thread(target=...)``
+    (or ``Timer``) construction, or a watchdog/supervisor ``escalate(name,
+    callback)`` registration. ``resolved`` holds every (module name, def node)
+    the target expression may denote — bound methods (``self._loop``), nested
+    closures, imported symbols."""
+
+    module: str
+    call: ast.Call
+    kind: str  # "thread" | "callback"
+    target: Optional[ast.AST]  # the target expression (Name/Attribute/Lambda)
+    resolved: List[Tuple[str, ast.AST]] = field(default_factory=list)
 
 
 def module_name_for(rel: str) -> str:
@@ -97,7 +125,61 @@ class Project:
             name: astutils.traced_functions(info.ctx.tree, info.aliases)
             for name, info in self.modules.items()
         }
+        #: every Thread(target=...)/Timer/escalate(...) registration, with the
+        #: target resolved to def nodes — the conc analyzer's entry points,
+        #: and extra call edges for the traced-function fixpoint
+        self.thread_targets: List[ThreadTarget] = []
+        for info in self.modules.values():
+            self._collect_thread_targets(info)
         self._fixpoint()
+
+    # -- thread entry points -------------------------------------------------
+
+    def _collect_thread_targets(self, info: ModuleInfo) -> None:
+        al = info.aliases
+        for node in ast.walk(info.ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            target: Optional[ast.AST] = None
+            kind = ""
+            d = dotted(fn)
+            parts = d.split(".") if d else []
+            is_thread = (isinstance(fn, ast.Name) and fn.id in al.thread_class) or (
+                len(parts) >= 2 and parts[0] in al.threading and parts[-1] in ("Thread", "Timer")
+            )
+            if is_thread:
+                kind = "thread"
+                for kw in node.keywords:
+                    if kw.arg in ("target", "function"):
+                        target = kw.value
+            elif isinstance(fn, ast.Attribute) and fn.attr == "escalate" and len(node.args) >= 2:
+                # watchdog.escalate(heartbeat_name, callback): the callback
+                # runs on the watchdog thread when the heartbeat stalls
+                kind = "callback"
+                target = node.args[1]
+            else:
+                continue
+            tt = ThreadTarget(module=info.name, call=node, kind=kind, target=target)
+            if isinstance(target, ast.Lambda):
+                tt.resolved.append((info.name, target))
+            elif isinstance(target, ast.Name):
+                resolved = self._defs_for(info, target)
+                if resolved:
+                    tt.resolved.extend(resolved)
+                else:
+                    for d_ in info.defs_by_name.get(target.id, []):
+                        tt.resolved.append((info.name, d_))
+            elif isinstance(target, ast.Attribute):
+                if isinstance(target.value, ast.Name) and target.value.id == "self":
+                    # bound method: resolve by bare attr name in this module;
+                    # the conc analyzer narrows to the lexically enclosing class
+                    for d_ in info.defs_by_name.get(target.attr, []):
+                        tt.resolved.append((info.name, d_))
+                else:
+                    tt.resolved.extend(self._defs_for(info, target))
+            if target is not None:
+                self.thread_targets.append(tt)
 
     # -- import resolution ---------------------------------------------------
 
@@ -202,8 +284,8 @@ class Project:
             changed = False
             for fn in list(traced):
                 for node in ast.walk(fn):
-                    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
-                        for callee in info.defs_by_name.get(node.func.id, []):
+                    if isinstance(node, ast.Call):
+                        for callee in astutils._closure_callees(node, info.defs_by_name):
                             if callee not in traced:
                                 traced.add(callee)
                                 changed = grew = True
@@ -227,12 +309,18 @@ class Project:
             info = self.modules[name]
             self._local_closure(name)
             touched: Set[str] = set()
-            # dynamic edges: calls out of traced bodies into imported symbols
+            # dynamic edges: calls out of traced bodies into imported symbols,
+            # including callable args to higher-order entry points
+            # (lax.scan(imported_body, ...) taints the body's home module)
             for fn in list(self._traced[name]):
                 for node in ast.walk(fn):
                     if not isinstance(node, ast.Call):
                         continue
-                    for mod, d in self._defs_for(info, node.func):
+                    targets = list(self._defs_for(info, node.func))
+                    for ref in callable_arg_refs(node):
+                        if isinstance(ref, (ast.Name, ast.Attribute)):
+                            targets.extend(self._defs_for(info, ref))
+                    for mod, d in targets:
                         if d not in self._traced[mod]:
                             self._traced[mod].add(d)
                             touched.add(mod)

@@ -12,7 +12,11 @@ Options:
                          exit 0 (each entry gets a TODO justification)
     --prune-baseline     drop stale baseline entries (keeping comments and
                          justifications verbatim) and exit 0
-    --select R1,R2       run only the listed rule ids
+    --select R1,R2       run only the listed rule ids; a prefix selects the
+                         whole family (--select CC = CC001..CC005)
+    --jobs N             check files on N forked workers (parse + call graph
+                         + conc model stay in the parent, inherited CoW);
+                         N<=1 or platforms without fork run serially
     --list-rules         print the rule registry and exit
 
 Exit status: 1 if any *new* finding (not noqa'd, not baselined), else 0 —
@@ -23,7 +27,7 @@ import argparse
 import sys
 
 from trlx_tpu.analysis import baseline as baseline_mod
-from trlx_tpu.analysis.core import RULES, run
+from trlx_tpu.analysis.core import RULES, resolve_select, run
 
 DEFAULT_BASELINE = "graftcheck-baseline.txt"
 
@@ -38,12 +42,14 @@ def main(argv=None) -> int:
     parser.add_argument("--no-baseline", action="store_true")
     parser.add_argument("--write-baseline", action="store_true")
     parser.add_argument("--prune-baseline", action="store_true")
-    parser.add_argument("--select", default=None, help="comma-separated rule ids")
+    parser.add_argument("--select", default=None, help="comma-separated rule ids or family prefixes")
+    parser.add_argument("--jobs", type=int, default=1, help="process-parallel file checking")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
     # populate the registry for --list-rules before any file is scanned
     from trlx_tpu.analysis import rules_jax, rules_spmd, rules_threads  # noqa: F401
+    from trlx_tpu.analysis.conc import rules_conc  # noqa: F401
 
     if args.list_rules:
         for rid in sorted(RULES):
@@ -54,7 +60,7 @@ def main(argv=None) -> int:
     if args.select:
         select = [r.strip() for r in args.select.split(",") if r.strip()]
     try:
-        findings = run(args.paths or ["trlx_tpu"], select=select)
+        findings = run(args.paths or ["trlx_tpu"], select=select, jobs=args.jobs)
     except ValueError as e:
         print(f"graftcheck: {e}", file=sys.stderr)
         return 2
@@ -76,6 +82,19 @@ def main(argv=None) -> int:
 
     base = baseline_mod.load("/dev/null" if args.no_baseline else args.baseline)
     new, stale = baseline_mod.compare(findings, base)
+    # a subsetted run cannot prove an entry stale: a rule that did not run,
+    # or a file that was not scanned (precommit's changed-files list), never
+    # had the chance to re-find it. Malformed keys (no path:RULE:text shape)
+    # stay reported — they can never match a finding under any subset.
+    if select:
+        ran = {rule.id for rule in resolve_select(select)}
+        stale = [k for k in stale if k.count(":") < 2 or k.split(":", 2)[1] in ran]
+    scanned = [p.rstrip("/") for p in (args.paths or ["trlx_tpu"])]
+    stale = [
+        k for k in stale
+        if k.count(":") < 2
+        or any(k.split(":", 1)[0] == p or k.split(":", 1)[0].startswith(p + "/") for p in scanned)
+    ]
 
     for f in new:
         print(f)

@@ -29,6 +29,7 @@ Suppression layers, in order of preference:
 """
 
 import ast
+import os
 import re
 import tokenize
 from dataclasses import dataclass, field
@@ -183,21 +184,56 @@ def check_file(ctx: FileContext, rules: Optional[Sequence[Rule]] = None) -> List
     return out
 
 
-def run(paths: Sequence, select: Optional[Sequence[str]] = None) -> List[Finding]:
+def resolve_select(select: Sequence[str]) -> List[Rule]:
+    """Selectors -> rule instances. A selector matches its exact rule id, or
+    — as a *family prefix* — every registered rule id starting with it
+    (``--select CC`` runs CC001–CC005). Unknown selectors raise."""
+    out: List[Rule] = []
+    seen: Set[str] = set()
+    unknown: List[str] = []
+    for sel in select:
+        if sel in RULES:
+            matched = [sel]
+        else:
+            matched = sorted(r for r in RULES if r.startswith(sel))
+        if not matched:
+            unknown.append(sel)
+        for rid in matched:
+            if rid not in seen:
+                seen.add(rid)
+                out.append(RULES[rid])
+    if unknown:
+        raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
+    return out
+
+
+# module state for the --jobs fork pool: workers inherit the parsed contexts,
+# the call graph, and the conc report copy-on-write instead of pickling them
+_POOL_STATE: Dict[str, object] = {}
+
+
+def _check_indexed(i: int) -> List[Finding]:
+    contexts = _POOL_STATE["contexts"]
+    rules = _POOL_STATE["rules"]
+    return check_file(contexts[i], rules)  # type: ignore[index]
+
+
+def run(paths: Sequence, select: Optional[Sequence[str]] = None, jobs: int = 1) -> List[Finding]:
     """Check every ``.py`` under ``paths``; unparseable files yield a single
     ``GC000`` finding (lint.py owns the pretty E999, this keeps graftcheck
-    standalone)."""
+    standalone). ``jobs > 1`` fans the per-file checks out over a fork pool —
+    parsing, the call graph, and the conc model stay in the parent (they are
+    whole-program), the workers inherit them copy-on-write."""
     # rules register on import; import here so `from analysis.core import run`
     # alone is enough to get the full registry
     from trlx_tpu.analysis import rules_jax, rules_spmd, rules_threads  # noqa: F401
+    from trlx_tpu.analysis.conc import rules_conc  # noqa: F401
     from trlx_tpu.analysis.callgraph import Project
+    from trlx_tpu.analysis.conc import model as conc_model, seeds as conc_seeds
 
     rules: Optional[List[Rule]] = None
     if select is not None:
-        unknown = set(select) - set(RULES)
-        if unknown:
-            raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
-        rules = [RULES[r] for r in select]
+        rules = resolve_select(select)
     findings: List[Finding] = []
     contexts: List[FileContext] = []
     for f in iter_py_files(paths):
@@ -209,10 +245,37 @@ def run(paths: Sequence, select: Optional[Sequence[str]] = None) -> List[Finding
             findings.append(
                 Finding(path=rel, lineno=lineno, rule="GC000", message=f"unparseable: {e}")
             )
+    # seeded regressions mutate the parsed ASTs before any whole-program
+    # structure is built (TRLX_CONC_SEED_REGRESSION; no-op when unset)
+    conc_seeds.apply(contexts)
     # two-phase: parse everything, then build the cross-module call graph so
-    # every rule sees jit taint that crosses file boundaries
+    # every rule sees jit taint that crosses file boundaries, then the conc
+    # model on top of it (both computed once, shared by every rule)
     project = Project(contexts)
+    conc_model.analyze(project)
     for ctx in contexts:
         ctx.project = project
+    # more workers than cores is pure fork/pickle overhead: on a 1-core host
+    # --jobs N degrades to the serial path instead of paying for a pool
+    jobs = min(jobs, os.cpu_count() or 1)
+    if jobs > 1 and len(contexts) > 1:
+        try:
+            import multiprocessing
+
+            mp = multiprocessing.get_context("fork")
+        except ValueError:
+            mp = None
+        if mp is not None:
+            _POOL_STATE["contexts"] = contexts
+            _POOL_STATE["rules"] = rules
+            try:
+                with mp.Pool(min(jobs, len(contexts))) as pool:
+                    for file_findings in pool.map(_check_indexed, range(len(contexts))):
+                        findings.extend(file_findings)
+                return findings
+            finally:
+                _POOL_STATE.clear()
+        # fork unavailable: fall through to the serial path
+    for ctx in contexts:
         findings.extend(check_file(ctx, rules))
     return findings

@@ -105,23 +105,31 @@ class StallWatchdog:
     # -------------------------------------------------------------- lifecycle
 
     def start(self):
-        if self._thread is not None:
-            return
+        # _thread is guarded: concurrent start()/stop()/running callers (the
+        # trainer plus obs shutdown hooks) race on the handle otherwise
+        # the Event is its own synchronization — clear it outside the section
         self._stop_evt.clear()
-        self._thread = threading.Thread(
-            target=self._loop, name="obs-watchdog", daemon=True
-        )
-        self._thread.start()
+        with self._lock:
+            if self._thread is not None:
+                return
+            thread = threading.Thread(
+                target=self._loop, name="obs-watchdog", daemon=True
+            )
+            self._thread = thread
+        thread.start()
 
     def stop(self, timeout: float = 5.0):
         self._stop_evt.set()
-        thread, self._thread = self._thread, None
+        with self._lock:
+            thread, self._thread = self._thread, None
         if thread is not None:
-            thread.join(timeout)
+            thread.join(timeout)  # outside the lock: beat() must never wait on it
 
     @property
     def running(self) -> bool:
-        return self._thread is not None and self._thread.is_alive()
+        with self._lock:
+            thread = self._thread
+        return thread is not None and thread.is_alive()
 
     def _loop(self):
         while not self._stop_evt.wait(self.poll_s):

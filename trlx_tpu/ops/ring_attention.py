@@ -56,7 +56,8 @@ def _block_attn(q, k, v, m, l, acc, scale, mask):
 def _shard_mask(causal, src, my, valid_cur, tri):
     """Visiting-shard mask: key validity x shard-granularity causal structure."""
     mask = valid_cur[:, None, None, :] > 0  # [B,1,1,Tk]
-    if causal:
+    # static python bool: the branch specializes the trace, it never sees an array
+    if causal:  # graftcheck: noqa[JX004]
         sm = jnp.logical_or(src < my, jnp.logical_and(src == my, tri))
         mask = jnp.logical_and(mask, sm[None, None])
     return mask
@@ -85,7 +86,8 @@ def _ring_fwd_local(q_loc, k_loc, v_loc, valid_loc, *, axis_name, n, causal, sca
     q_loc, rep = _fold_q(q_loc, Hkv)
     my = jax.lax.axis_index(axis_name)
     tri = jnp.tril(jnp.ones((T, T), dtype=bool))
-    if rep > 1:
+    # rep is shape-derived (static at trace time): specialization, not data branching
+    if rep > 1:  # graftcheck: noqa[JX004]
         tri = jnp.tile(tri, (rep, 1))  # folded row r*T+t keeps position t's row
 
     def body(step, carry):

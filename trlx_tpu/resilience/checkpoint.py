@@ -159,17 +159,24 @@ class AsyncCheckpointWriter:
     def __init__(self, keep_last: int = 0, protected: Optional[List[str]] = None):
         self.keep_last = keep_last
         self.protected = list(protected or [])
+        # guards the writer handle and its results: the commit thread writes
+        # _error/_last_committed while the learner thread polls in_flight/
+        # last_committed between saves
+        self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._last_committed: Optional[str] = None
 
     @property
     def in_flight(self) -> bool:
-        return self._thread is not None and self._thread.is_alive()
+        with self._lock:
+            thread = self._thread
+        return thread is not None and thread.is_alive()
 
     @property
     def last_committed(self) -> Optional[str]:
-        return self._last_committed
+        with self._lock:
+            return self._last_committed
 
     def save(
         self,
@@ -192,14 +199,16 @@ class AsyncCheckpointWriter:
                     write_checkpoint(path, trees, state)
                 if self.keep_last:
                     gc_checkpoints(os.path.dirname(path), self.keep_last, self.protected)
-                self._last_committed = os.path.abspath(path)
+                with self._lock:
+                    self._last_committed = os.path.abspath(path)
                 gauges.inc("resilience/ckpt_committed")
                 gauges.set("resilience/ckpt_commit_s", time.monotonic() - t0)
                 logger.info(
                     f"Committed checkpoint {path} in {time.monotonic() - t0:.2f}s"
                 )
             except BaseException as e:
-                self._error = e
+                with self._lock:
+                    self._error = e
                 logger.error(f"Checkpoint commit to {path} FAILED: {e}")
             finally:
                 gauges.set("resilience/ckpt_inflight", 0.0)
@@ -207,21 +216,28 @@ class AsyncCheckpointWriter:
                 watchdog.unregister(WRITER_HEARTBEAT)
 
         gauges.set("resilience/ckpt_inflight", 1.0)
-        self._thread = threading.Thread(target=commit, name="ckpt-writer", daemon=True)
-        self._thread.start()
+        thread = threading.Thread(target=commit, name="ckpt-writer", daemon=True)
+        with self._lock:
+            self._thread = thread
+        thread.start()
         if block:
             self.wait()
 
     def wait(self, timeout: Optional[float] = None) -> None:
         """Join the in-flight write (if any); re-raise its error here."""
-        thread = self._thread
+        with self._lock:
+            thread = self._thread
         if thread is not None:
+            # join OUTSIDE the lock: a commit in flight holds the disk for the
+            # full serialize+fsync and in_flight/last_committed must stay live
             thread.join(timeout)
             if thread.is_alive():
                 raise TimeoutError(f"checkpoint write still in flight after {timeout}s")
-            self._thread = None
-        if self._error is not None:
+        with self._lock:
+            if self._thread is thread:  # re-check: a newer save() may have swapped
+                self._thread = None
             err, self._error = self._error, None
+        if err is not None:
             raise RuntimeError("async checkpoint write failed") from err
 
     def close(self) -> None:

@@ -135,14 +135,18 @@ class AsyncRolloutEngine:
     # ---------------------------------------------------------------- lifecycle
 
     def start(self):
-        if self._thread is not None:
-            raise RuntimeError("engine already started")
-        self._wall_start = time.monotonic()
+        # the handle/counters are guarded: running/summary()/overlap_fraction()
+        # are read from the learner thread while this engine starts elsewhere
+        with self._stats_lock:
+            if self._thread is not None:
+                raise RuntimeError("engine already started")
+            self._wall_start = time.monotonic()
+            thread = threading.Thread(target=self._loop, name=self._name, daemon=True)
+            self._thread = thread
         # register the heartbeat before the first produce: a producer wedged on
         # its very first iteration must still be detectable
         watchdog.beat(PRODUCER_HEARTBEAT)
-        self._thread = threading.Thread(target=self._loop, name=self._name, daemon=True)
-        self._thread.start()
+        thread.start()
 
     def _loop(self):
         try:
@@ -198,13 +202,16 @@ class AsyncRolloutEngine:
         except QueueClosed:
             pass
         except BaseException as e:  # noqa: B036 — re-raised from collect/stop
-            self._error = e
+            with self._stats_lock:
+                self._error = e
             logger.error(f"async rollout producer died: {type(e).__name__}: {e}")
         finally:
             # a dead producer must never leave the learner blocked in get() —
             # except under supervision, where the queue is shared with the
             # replacement engine and collect() detects death by polling
-            if self._close_queue_on_death and not self._abandoned:
+            with self._stats_lock:
+                close_queue = self._close_queue_on_death and not self._abandoned
+            if close_queue:
                 self.queue.close()
 
     def stop(self, timeout: Optional[float] = 30.0) -> dict:
@@ -212,15 +219,23 @@ class AsyncRolloutEngine:
         self._stop_evt.set()
         self.queue.close()
         try:
-            if self._thread is not None:
-                self._thread.join(timeout)
-                if self._thread.is_alive():
+            with self._stats_lock:
+                thread = self._thread
+            if thread is not None:
+                # join OUTSIDE the lock: the producer's finally-clause and the
+                # stats/gauge readers must stay live while we wait it out
+                thread.join(timeout)
+                if thread.is_alive():
                     raise RuntimeError(
                         f"rollout producer failed to stop within {timeout}s"
                     )
-                self._thread = None
-            if self._error is not None:
-                raise RuntimeError("async rollout producer died") from self._error
+                with self._stats_lock:
+                    if self._thread is thread:  # re-check under the lock
+                        self._thread = None
+            with self._stats_lock:
+                error = self._error
+            if error is not None:
+                raise RuntimeError("async rollout producer died") from error
             stats = self.summary()
             stats["leftover"] = self.queue.qsize()
             return stats
@@ -238,12 +253,26 @@ class AsyncRolloutEngine:
         a genuinely wedged thread cannot be joined, and as a daemon it is
         harmless once abandoned. Its finally-clause is told not to close the
         queue either, so the successor engine keeps feeding the same queue."""
-        self._abandoned = True
+        with self._stats_lock:
+            self._abandoned = True
         self._stop_evt.set()
 
     @property
     def running(self) -> bool:
-        return self._thread is not None and self._thread.is_alive()
+        with self._stats_lock:
+            thread = self._thread
+        return thread is not None and thread.is_alive()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Best-effort join of the producer thread without ``stop()`` semantics
+        (the supervisor reaps abandoned generations this way). Returns whether
+        the thread is still alive afterwards."""
+        with self._stats_lock:
+            thread = self._thread
+        if thread is None:
+            return False
+        thread.join(timeout)
+        return thread.is_alive()
 
     @contextlib.contextmanager
     def paused(self):
@@ -268,8 +297,10 @@ class AsyncRolloutEngine:
                 )
             got = self.queue.get(n - len(out), timeout=1.0 if remaining is None else min(1.0, remaining))
             if not got:
-                if self._error is not None:
-                    raise RuntimeError("async rollout producer died") from self._error
+                with self._stats_lock:
+                    error = self._error
+                if error is not None:
+                    raise RuntimeError("async rollout producer died") from error
                 if self.queue.closed and self.queue.qsize() == 0:
                     raise RuntimeError(
                         f"experience queue closed after {len(out)}/{n} rollouts"
@@ -299,11 +330,12 @@ class AsyncRolloutEngine:
     def overlap_fraction(self) -> float:
         """Fraction of engine wall-time the producer spent generating — the
         recovered generator utilization (1.0 = fully hidden behind learning)."""
-        if self._wall_start is None:
-            return 0.0
-        wall = max(time.monotonic() - self._wall_start, 1e-9)
         with self._stats_lock:
+            wall_start = self._wall_start
             busy = self._busy_time
+        if wall_start is None:
+            return 0.0
+        wall = max(time.monotonic() - wall_start, 1e-9)
         return min(1.0, busy / wall)
 
     def summary(self) -> dict:

@@ -127,8 +127,7 @@ class ProducerSupervisor:
             stats["leftover"] = engine.queue.qsize()
             engine.queue.close()
         for old in self._abandoned:
-            if old._thread is not None:
-                old._thread.join(timeout=1.0)  # best effort; wedged daemons linger
+            old.join(timeout=1.0)  # best effort; wedged daemons linger
         stats["produced"] += self._dead_produced
         stats["dropped_shutdown"] += self._dead_dropped_shutdown
         stats["producer_restarts"] = self.restarts
@@ -246,4 +245,9 @@ class ProducerSupervisor:
                 continue
             out.extend(got)
             last_progress = time.monotonic()
+            # delivery disproves a pending wedge escalation: the watchdog may
+            # have flagged the producer just as it recovered, and acting on
+            # that stale flag would abandon a healthy generation and burn a
+            # restart. Queue delivery IS the producer's liveness proof.
+            self._wedge_evt.clear()
         return out

@@ -46,7 +46,7 @@ class GenerationClient:
         return self.engine.cancel(uid)
 
     def _request(self, uid: int) -> Request:
-        req = self.engine.scheduler.requests.get(uid)
+        req = self.engine.scheduler.get_request(uid)
         if req is None:
             raise KeyError(f"unknown request uid {uid}")
         return req
@@ -99,7 +99,7 @@ class GenerationClient:
         mask = np.zeros((B, N), np.int32)
         for i, (uid, p) in enumerate(zip(uids, prompts)):
             req = done[uid]
-            engine.scheduler.requests.pop(uid, None)
+            engine.scheduler.pop_request(uid)
             p = np.asarray(p, np.int32)
             gen = np.asarray(req.generated, np.int32)
             seqs[i, P - len(p):P] = p

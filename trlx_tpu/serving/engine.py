@@ -365,15 +365,20 @@ class ServingEngine:
     # -- observability -------------------------------------------------------
 
     def summary(self) -> Dict[str, float]:
-        return {
-            "delivered_tokens": float(self.stats.delivered_tokens),
-            "decode_steps": float(self.stats.decode_steps),
-            "prefill_waves": float(self.stats.prefill_waves),
-            "finished_requests": float(self.stats.finished_requests),
-            "mean_slot_occupancy": self.scheduler.mean_slot_occupancy,
-            "prefix_cache_hit_rate": self.allocator.stats.hit_rate,
-            "blocks_in_use": float(self.allocator.blocks_in_use),
-        }
+        # stats counters are written by step() under self._lock; snapshot them
+        # under the same lock so a gauge read during a concurrent round is
+        # consistent (the scheduler/allocator figures take their own locks)
+        with self._lock:
+            out = {
+                "delivered_tokens": float(self.stats.delivered_tokens),
+                "decode_steps": float(self.stats.decode_steps),
+                "prefill_waves": float(self.stats.prefill_waves),
+                "finished_requests": float(self.stats.finished_requests),
+            }
+        out["mean_slot_occupancy"] = self.scheduler.mean_slot_occupancy
+        out["prefix_cache_hit_rate"] = self.allocator.stats.hit_rate
+        out["blocks_in_use"] = float(self.allocator.blocks_in_use)
+        return out
 
     def export_gauges(self) -> None:
         s = self.summary()

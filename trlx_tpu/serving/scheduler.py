@@ -99,7 +99,7 @@ class InflightScheduler:
             self._cancelled.add(uid)
         # racy-but-benign read of engine-thread state: a request placed
         # concurrently is still reaped next round via _cancelled
-        return any(r is not None and r.uid == uid for r in self.slots)
+        return any(r is not None and r.uid == uid for r in self.slots)  # graftcheck: noqa[CC001]
 
     @property
     def has_work(self) -> bool:
@@ -117,6 +117,18 @@ class InflightScheduler:
         with self._lock:
             out, self.finished = self.finished, {}
         return out
+
+    def get_request(self, uid: int) -> Optional[Request]:
+        """Locked lookup in the uid index (producers mutate it in submit)."""
+        with self._lock:
+            return self.requests.get(uid)
+
+    def pop_request(self, uid: int) -> Optional[Request]:
+        """Drop a request from the uid index once the consumer has collected
+        it — locked against producer-side ``submit()`` writing the same map
+        (client-side ``dict.pop`` on the bare attribute raced it)."""
+        with self._lock:
+            return self.requests.pop(uid, None)
 
     # -- engine-side rounds --------------------------------------------------
 
@@ -199,9 +211,15 @@ class InflightScheduler:
         return None
 
     def note_step(self) -> None:
-        self.steps += 1
-        self.occupied_slot_steps += self.live_slots
+        # locked: the occupancy gauge (bench/obs threads) reads these counters
+        # while the engine loop advances them
+        live = self.live_slots
+        with self._lock:
+            self.steps += 1
+            self.occupied_slot_steps += live
 
     @property
     def mean_slot_occupancy(self) -> float:
-        return self.occupied_slot_steps / max(1, self.steps) / max(1, self.num_slots)
+        with self._lock:
+            steps, occupied = self.steps, self.occupied_slot_steps
+        return occupied / max(1, steps) / max(1, self.num_slots)
