@@ -113,6 +113,21 @@ echo "== serving tests (CPU)"
 JAX_PLATFORMS=cpu timeout -k 10 600 \
     python -m pytest tests/test_serving.py tests/test_paged_attention.py -q -m "not slow" -p no:cacheprovider
 
+echo "== serving fault-tolerance tests (CPU)"
+# deadlines/TTL expiry, watermark load shedding, KV-pressure preemption,
+# supervised restart+replay, and the 64-request chaos soak; bounded so a
+# wedged engine (the thing the suite injects on purpose) fails fast
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_serving_resilience.py -q -m "not slow" -p no:cacheprovider
+
+echo "== serving seeded-wedge gate (must recover in exactly one restart)"
+# the serving gate proves itself the same way the conc gate does: arm the
+# wedge chaos site from the environment and require the supervisor to detect
+# the stall, restart once, and finish every request — a supervisor that
+# cannot survive the fault it was built for is not a supervisor
+JAX_PLATFORMS=cpu TRLX_CHAOS=serving-wedge:1 timeout -k 10 300 \
+    python -m pytest tests/test_serving_resilience.py -q -k seeded_wedge -p no:cacheprovider
+
 echo "== chaos soak smoke (CPU)"
 # the acceptance scenario by name: producer crashes + nan-loss + bad elements
 # + reward faults in one run, every recovery visible in gauges/summary

@@ -1,0 +1,566 @@
+"""Serving fault-tolerance tests (docs/serving.md "Fault tolerance"):
+deadline/TTL expiry (pending and live), watermark load shedding, KV-pressure
+preemption parity, graceful drain, the `RequestTooLarge` submit guard, the
+stream liveness contract (typed shed/expired/stopped errors instead of an
+infinite spin), supervised restart + replay (crash, wedge, and the fail-closed
+restart budget), and the chaos-armed multi-tenant soak — every submitted uid
+must end in exactly one accountable terminal state with allocator invariants
+intact across restarts."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.presets import PRESETS
+from trlx_tpu.models.transformer import TransformerLM
+from trlx_tpu.resilience.chaos import chaos
+from trlx_tpu.serving import (
+    EngineDrainingError,
+    EngineStoppedError,
+    GenerationClient,
+    InflightScheduler,
+    PagedBlockAllocator,
+    RequestExpiredError,
+    RequestShedError,
+    RequestTooLarge,
+    ServingEngine,
+    ServingResiliencePolicy,
+    ServingRestartBudgetExceeded,
+    ServingSupervisor,
+)
+from trlx_tpu.serving.scheduler import (
+    FINISH_CANCELLED,
+    FINISH_DEADLINE,
+    FINISH_EOS,
+    FINISH_LENGTH,
+    FINISH_SHED,
+    FINISH_STOP,
+)
+from trlx_tpu.utils.metrics import gauges
+
+pytestmark = [pytest.mark.serving, pytest.mark.serving_chaos]
+
+TINY = dict(
+    vocab_size=37, hidden_size=16, num_layers=2, num_heads=2,
+    max_position_embeddings=64, compute_dtype=jnp.float32,
+)
+
+#: every accountable way a request may end (the soak's exhaustive set)
+TERMINAL_REASONS = {
+    FINISH_EOS, FINISH_STOP, FINISH_LENGTH, FINISH_CANCELLED,
+    FINISH_DEADLINE, FINISH_SHED,
+}
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    yield
+    chaos.configure(None)
+
+
+@pytest.fixture(scope="module")
+def tiny_engine_parts():
+    config = PRESETS["gpt2"].replace(**TINY)
+    model = TransformerLM(config)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32), jnp.ones((1, 4), jnp.int32)
+    )["params"]
+    return model, params, config
+
+
+def _make_engine(parts, *, num_slots=3, num_blocks=0, policy=None, max_seq_len=32,
+                 seed=0, prefix_caching=False):
+    model, params, _ = parts
+    return ServingEngine(
+        model, params, num_slots=num_slots, max_seq_len=max_seq_len, block_size=4,
+        num_blocks=num_blocks, eos_token_id=None, pad_token_id=0,
+        gen_kwargs=dict(do_sample=False), seed=seed, policy=policy,
+        prefix_caching=prefix_caching,
+    )
+
+
+def _assert_greedy_equivalent(parts, prompt, gen_a, gen_b, tol=1e-3):
+    """Two greedy runs over the same prompt must match token-for-token —
+    except at a genuine argmax tie. CPU matmul reductions are not bitwise
+    deterministic run-to-run on the tiny random-init model (near-uniform
+    logits), so a flipped near-tie is float noise, not a bug; a real
+    replay/preemption bug decodes from the WRONG context and diverges with a
+    large logit gap. At the first divergence we recompute the exact next-token
+    logits and require the two picks to be within ``tol`` of each other (after
+    that point the trajectories legitimately differ)."""
+    model, params, _ = parts
+    assert len(gen_a) == len(gen_b)
+    for i, (ta, tb) in enumerate(zip(gen_a, gen_b)):
+        if ta == tb:
+            continue
+        ctx = list(prompt) + list(gen_a[:i])
+        ids = jnp.asarray([ctx], jnp.int32)
+        mask = jnp.ones_like(ids)
+        positions = jnp.arange(len(ctx), dtype=jnp.int32)[None]
+        cache = {**model.init_cache(1, len(ctx)), "index": 0}
+        logits, _, _, _ = model.apply({"params": params}, ids, mask, positions, cache)
+        last = np.asarray(logits[0, -1], np.float64)
+        gap = abs(last[ta] - last[tb])
+        assert gap < tol, (
+            f"greedy runs diverged at token {i} ({ta} vs {tb}) with logit gap "
+            f"{gap:.3e} — not a float tie: the runs decoded different contexts"
+        )
+        return  # past a flipped tie the suffixes legitimately differ
+
+
+# ------------------------------------------------------------------- policy
+
+
+def test_policy_validates_watermarks_and_bounds():
+    with pytest.raises(ValueError, match="watermarks"):
+        ServingResiliencePolicy(max_pending=8, high_watermark=0.3, low_watermark=0.5)
+    with pytest.raises(ValueError, match="watermarks"):
+        ServingResiliencePolicy(low_watermark=0.0)
+    with pytest.raises(ValueError, match="max_pending"):
+        ServingResiliencePolicy(max_pending=-1)
+    p = ServingResiliencePolicy(max_pending=10, high_watermark=0.8, low_watermark=0.5)
+    assert p.shed_trigger == 8 and p.shed_target == 5
+    assert ServingResiliencePolicy().shed_trigger == 0  # unbounded: never sheds
+
+
+# ---------------------------------------------------------------- allocator
+
+
+def test_allocator_extend_grows_or_fails_atomically():
+    a = PagedBlockAllocator(num_blocks=6, block_size=4, prefix_caching=False)
+    s = a.allocate(list(range(4)), 4)  # 1 block, 4 free after
+    assert a.extend(s, 4) is True and len(s.blocks) == 1  # covered already
+    assert a.extend(s, 5) is True and len(s.blocks) == 2  # grew one block
+    a.check_invariants()
+    # 24 tokens need 6 blocks; only 3 free — refuse without allocating any
+    assert a.extend(s, 24) is False
+    assert len(s.blocks) == 2 and a.free_blocks == 3
+    a.check_invariants()
+    a.free(s)
+    a.check_invariants()
+
+
+# ---------------------------------------------------------------- scheduler
+
+
+def test_pending_requests_expire_by_deadline_and_age():
+    t = [0.0]
+    a = PagedBlockAllocator(num_blocks=16, block_size=4, prefix_caching=False)
+    pol = ServingResiliencePolicy(request_ttl_s=5.0, max_pending_age_s=20.0)
+    s = InflightScheduler(2, a, policy=pol, clock=lambda: t[0])
+    u_ttl = s.submit([1, 2], 4)  # defaults deadline_s from the policy TTL
+    u_long = s.submit([3, 4], 4, deadline_s=100.0)  # outlives the TTL...
+    t[0] = 6.0
+    expired = s.expire_and_shed_pending()
+    assert [r.uid for r in expired] == [u_ttl]
+    assert s.requests[u_ttl].finish_reason == FINISH_DEADLINE
+    t[0] = 25.0  # ...but not the pending-age bound
+    expired = s.expire_and_shed_pending()
+    assert [r.uid for r in expired] == [u_long]
+    assert s.expired_count == 2
+    assert set(s.pop_finished()) == {u_ttl, u_long}
+
+
+def test_watermark_shedding_evicts_oldest_down_to_target():
+    t = [0.0]
+    a = PagedBlockAllocator(num_blocks=16, block_size=4, prefix_caching=False)
+    pol = ServingResiliencePolicy(max_pending=4, high_watermark=1.0, low_watermark=0.5)
+    s = InflightScheduler(0, a, policy=pol, clock=lambda: t[0])  # no slots: all pend
+    uids = []
+    for i in range(6):
+        t[0] = float(i)  # strictly increasing submit times
+        uids.append(s.submit([i], 2))
+    shed = s.expire_and_shed_pending()
+    # 6 pending > trigger 4 -> shed the 4 oldest down to target 2
+    assert [r.uid for r in shed] == uids[:4]
+    assert all(r.finish_reason == FINISH_SHED for r in shed)
+    assert s.shed_count == 4 and s.pending_depth == 2
+    # survivors keep submit order
+    assert [r.uid for r in s._pending] == uids[4:]
+
+
+def test_preempt_requeues_front_with_generation_intact():
+    a = PagedBlockAllocator(num_blocks=32, block_size=4, prefix_caching=False)
+    pol = ServingResiliencePolicy(preemption=True)
+    s = InflightScheduler(2, a, policy=pol)
+    u0 = s.submit([1, 2, 3], 8)
+    s.admissions()
+    s.on_token(0, 11)
+    s.on_token(0, 12)
+    u_fresh = s.submit([4], 8)
+    req = s.preempt(0)
+    assert req.uid == u0 and req.preemptions == 1 and not req.done
+    assert req.seq_blocks is None and a.blocks_in_use == 0
+    a.check_invariants()
+    # re-queued at the FRONT (ahead of the fresh arrival) and re-prefills
+    # prompt + generated-so-far
+    assert [r.uid for r in s._pending] == [u0, u_fresh]
+    assert req.prefill_ids == [1, 2, 3, 11, 12] and req.remaining_tokens == 6
+    assert s.preempted_count == 1
+
+
+def test_export_adopt_preserves_uids_replay_and_counters():
+    a1 = PagedBlockAllocator(num_blocks=32, block_size=4, prefix_caching=False)
+    pol = ServingResiliencePolicy()
+    s1 = InflightScheduler(2, a1, policy=pol)
+    u_live = s1.submit([1, 2], 8)
+    s1.admissions()
+    s1.on_token(0, 5)  # one token decoded before the "crash"
+    u_pend = s1.submit([3, 4, 5], 8)
+    u_done = s1.submit([9], 8)
+    s1.cancel(u_done)
+    s1.shed_count = 3  # pretend outcome history
+    state = s1.export_state()
+    assert [r.uid for r in state["replay"]] == [u_live, u_pend]  # live first
+    assert all(r.seq_blocks is None for r in state["replay"])
+
+    a2 = PagedBlockAllocator(num_blocks=32, block_size=4, prefix_caching=False)
+    s2 = InflightScheduler(2, a2, policy=pol)
+    s2.adopt_state(state)
+    assert [r.uid for r in s2._pending] == [u_live, u_pend]
+    assert s2.requests[u_live].generated == [5]  # generation survives replay
+    assert s2.shed_count == 3  # counters cumulative across generations
+    assert u_done in s2.finished
+    # uid continuity: the successor never reissues a client-held uid
+    u_new = s2.submit([7], 4)
+    assert u_new > max(u_live, u_pend, u_done)
+
+
+# ------------------------------------------------------------------- engine
+
+
+def test_submit_rejects_request_too_large_instead_of_pending_forever(tiny_engine_parts):
+    """Regression: a request whose worst-case block need exceeds the whole
+    pool previously pended forever (and spun its client). It must be rejected
+    at submit, loudly."""
+    eng = _make_engine(tiny_engine_parts, num_slots=2, num_blocks=5)  # 4 usable
+    with pytest.raises(RequestTooLarge, match="never be admitted"):
+        eng.submit([1] * 8, 16)  # 24 tokens -> 6 blocks > 4
+    assert isinstance(RequestTooLarge("x"), ValueError)  # old catch sites keep working
+    uid = eng.submit([1] * 8, 4)  # 12 tokens -> 3 blocks: fits
+    done = eng.run([uid])
+    assert done[uid].finish_reason == FINISH_LENGTH
+
+
+def test_stream_surfaces_shed_and_expired_as_typed_errors(tiny_engine_parts):
+    pol = ServingResiliencePolicy()
+    eng = _make_engine(tiny_engine_parts, policy=pol)
+    client = GenerationClient(eng)
+    uid = client.submit([1, 2, 3], 4)
+    eng.begin_drain()  # sheds the pending request
+    with pytest.raises(RequestShedError, match=f"uid={uid}"):
+        list(client.stream(uid))
+
+    eng2 = _make_engine(tiny_engine_parts, policy=ServingResiliencePolicy())
+    t = [0.0]
+    eng2.scheduler.clock = lambda: t[0]
+    client2 = GenerationClient(eng2)
+    uid2 = client2.submit([1, 2, 3], 8, deadline_s=5.0)
+    t[0] = 10.0  # expires while pending: zero tokens, typed error, no spin
+    with pytest.raises(RequestExpiredError, match=f"uid={uid2}"):
+        list(client2.stream(uid2))
+
+
+def test_stream_raises_engine_stopped_instead_of_spinning(tiny_engine_parts):
+    """Liveness: if the engine runs out of work while a streamed request is
+    neither live nor terminal (a lost-request bug, by construction), the
+    iterator must raise, not spin forever."""
+    eng = _make_engine(tiny_engine_parts)
+    client = GenerationClient(eng)
+    uid = client.submit([1, 2], 4)
+    with eng.scheduler._lock:  # simulate the request falling out of the queue
+        eng.scheduler._pending.clear()
+    with pytest.raises(EngineStoppedError, match=f"uid={uid}"):
+        list(client.stream(uid))
+
+
+def test_live_request_expires_mid_decode_and_frees_its_blocks(tiny_engine_parts):
+    pol = ServingResiliencePolicy(request_ttl_s=50.0, preemption=False)
+    eng = _make_engine(tiny_engine_parts, num_slots=2, policy=pol)
+    t = [0.0]
+    eng.scheduler.clock = lambda: t[0]
+    uid = eng.submit([1, 2, 3], 20)
+    finished = eng.step()  # admit + first decode round: live, not done
+    assert finished == [] and eng.scheduler.live_slots == 1
+    t[0] = 60.0  # past the TTL while live
+    finished = eng.step()
+    assert [r.uid for r in finished] == [uid]
+    req = finished[0]
+    assert req.finish_reason == FINISH_DEADLINE
+    assert len(req.generated) >= 1  # partial output is part of the outcome
+    assert req.latency_s == pytest.approx(60.0)
+    assert eng.allocator.blocks_in_use == 0
+    eng.allocator.check_invariants()
+    assert eng.scheduler.expired_count == 1
+
+
+def test_preemption_under_kv_pressure_matches_unpressured_output(tiny_engine_parts):
+    """The central preemption correctness claim: a preempted sequence is
+    re-prefilled from host state (prompt + generated-so-far) and finishes
+    with EXACTLY the tokens it would have produced on a roomy pool."""
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, 37, size=n).tolist() for n in (6, 7, 8)]
+    pol = ServingResiliencePolicy(preemption=True)
+    # 7 usable blocks for three sequences growing to 16-18 tokens (4-5 blocks
+    # each): pressure is guaranteed; a lone sequence (5 blocks) always fits
+    tight = _make_engine(tiny_engine_parts, num_slots=3, num_blocks=8, policy=pol)
+    uids_t = [tight.submit(p, 10) for p in prompts]
+    done_t = tight.run(uids_t)
+    assert tight.scheduler.preempted_count > 0  # the path actually ran
+    tight.allocator.check_invariants()
+    assert tight.allocator.blocks_in_use == 0
+
+    roomy = _make_engine(tiny_engine_parts, num_slots=3, num_blocks=0, policy=None)
+    uids_r = [roomy.submit(p, 10) for p in prompts]
+    done_r = roomy.run(uids_r)
+    for prompt, ut, ur in zip(prompts, uids_t, uids_r):
+        assert done_t[ut].finish_reason == done_r[ur].finish_reason
+        _assert_greedy_equivalent(
+            tiny_engine_parts, prompt, done_t[ut].generated, done_r[ur].generated
+        )
+    assert any(done_t[u].preemptions > 0 for u in uids_t)
+
+
+def test_resilience_layer_without_faults_matches_plain_engine(tiny_engine_parts):
+    """Policy installed + supervisor wrapped, but no pressure and no chaos:
+    outputs must match the plain engine exactly (the layer observes, it does
+    not perturb)."""
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, 37, size=n).tolist() for n in (4, 6, 5, 8)]
+    plain = _make_engine(tiny_engine_parts, num_slots=3)
+    uids_p = [plain.submit(p, 6) for p in prompts]
+    done_p = plain.run(uids_p)
+
+    pol = ServingResiliencePolicy(request_ttl_s=3600.0, max_pending=64, preemption=True)
+    sup = ServingSupervisor(
+        lambda: _make_engine(tiny_engine_parts, num_slots=3, policy=pol),
+        max_restarts=2, backoff_base_s=0.01, wedge_timeout_s=None,
+    )
+    try:
+        uids_s = [sup.submit(p, 6) for p in prompts]
+        done_s = sup.run(uids_s)
+    finally:
+        sup.close()
+    assert sup.restarts == 0
+    for prompt, up, us in zip(prompts, uids_p, uids_s):
+        _assert_greedy_equivalent(
+            tiny_engine_parts, prompt, done_p[up].generated, done_s[us].generated
+        )
+        assert done_p[up].finish_reason == done_s[us].finish_reason
+
+
+def test_drain_sheds_pending_finishes_live_and_rejects_new(tiny_engine_parts):
+    pol = ServingResiliencePolicy()
+    eng = _make_engine(tiny_engine_parts, num_slots=2, policy=pol)
+    uids = [eng.submit([i + 1, i + 2], 6) for i in range(4)]
+    eng.step()  # two admitted live, two still pending
+    assert eng.scheduler.live_slots == 2
+    done = eng.drain()
+    assert set(done) == set(uids)
+    reasons = {u: done[u].finish_reason for u in uids}
+    assert sorted(reasons.values()) == [FINISH_LENGTH, FINISH_LENGTH, FINISH_SHED, FINISH_SHED]
+    # live requests finished with full budgets; shed ones never decoded
+    assert all(len(done[u].generated) == 6 for u in uids if reasons[u] == FINISH_LENGTH)
+    with pytest.raises(EngineDrainingError):
+        eng.submit([1], 2)
+    assert eng.allocator.blocks_in_use == 0
+    eng.allocator.check_invariants()
+
+
+# --------------------------------------------------------------- supervisor
+
+
+def test_supervised_restart_replays_requests_losing_nothing(tiny_engine_parts, tmp_path):
+    """A decode-round crash mid-flight restarts the engine and replays every
+    live + pending request; greedy outputs match an un-crashed run exactly."""
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, 37, size=n).tolist() for n in (5, 6, 7, 4)]
+    clean = _make_engine(tiny_engine_parts, num_slots=2)
+    done_clean = {u: r for u, r in clean.run(
+        [clean.submit(p, 6) for p in prompts]).items()}
+
+    pol = ServingResiliencePolicy()
+    sup = ServingSupervisor(
+        lambda: _make_engine(tiny_engine_parts, num_slots=2, policy=pol),
+        max_restarts=3, backoff_base_s=0.01, wedge_timeout_s=None,
+        diagnostics_dir=str(tmp_path),
+    )
+    try:
+        uids = [sup.submit(p, 6) for p in prompts]
+        sup.step()  # decode at least one token so the replay carries state
+        chaos.configure("serving-decode:1")
+        done = sup.run(uids)
+    finally:
+        sup.close()
+    assert sup.restarts == 1
+    assert set(done) == set(uids)
+    for prompt, uid, (_, req_clean) in zip(prompts, uids, sorted(done_clean.items())):
+        _assert_greedy_equivalent(
+            tiny_engine_parts, prompt, done[uid].generated, req_clean.generated
+        )
+    # uid continuity across the restart: no client-held uid is ever reissued
+    assert sup.submit([1, 2], 2) > max(uids)
+    assert gauges.get("serving/restarts") == 1.0
+
+
+def test_supervisor_budget_exhaustion_fails_closed_with_bundle(tiny_engine_parts, tmp_path):
+    pol = ServingResiliencePolicy()
+    diag = tmp_path / "diag"
+    sup = ServingSupervisor(
+        lambda: _make_engine(tiny_engine_parts, num_slots=2, policy=pol),
+        max_restarts=1, backoff_base_s=0.001, wedge_timeout_s=None,
+        diagnostics_dir=str(diag),
+    )
+    try:
+        sup.submit([1, 2, 3], 4)
+        chaos.configure("serving-prefill:99")  # permanent outage
+        with pytest.raises(ServingRestartBudgetExceeded, match="diagnostics bundle"):
+            sup.run()
+    finally:
+        sup.close()
+    assert sup.restarts == 2  # budget of 1 + the failing attempt
+    bundles = list(diag.glob("**/*"))
+    assert bundles, "fail-closed must leave a diagnostics bundle behind"
+
+
+def test_seeded_wedge_exactly_one_restart_all_requests_finish(tiny_engine_parts, tmp_path):
+    """The ci.sh serving-chaos self-test: a TRLX_CHAOS-seeded wedge on the
+    step loop must be aborted (wedge timer), trigger exactly one supervised
+    restart, and still finish every request."""
+    import os
+
+    chaos.configure(os.environ.get("TRLX_CHAOS") or "serving-wedge:1")
+    pol = ServingResiliencePolicy()
+    sup = ServingSupervisor(
+        lambda: _make_engine(tiny_engine_parts, num_slots=2, policy=pol),
+        max_restarts=3, backoff_base_s=0.01, wedge_timeout_s=0.2,
+        diagnostics_dir=str(tmp_path),
+    )
+    try:
+        uids = [sup.submit([i + 1, i + 2, i + 3], 5) for i in range(4)]
+        done = sup.run(uids)
+    finally:
+        sup.close()
+    assert sup.restarts == 1
+    assert set(done) == set(uids)
+    assert all(done[u].finish_reason == FINISH_LENGTH for u in uids)
+    assert chaos.stats().get("serving-wedge") == 1
+
+
+def test_supervised_drain_survives_a_restart(tiny_engine_parts, tmp_path):
+    """A crash mid-drain must not shed the replayed live requests — drain
+    promised they finish."""
+    pol = ServingResiliencePolicy()
+    sup = ServingSupervisor(
+        lambda: _make_engine(tiny_engine_parts, num_slots=2, policy=pol),
+        max_restarts=3, backoff_base_s=0.01, wedge_timeout_s=None,
+        diagnostics_dir=str(tmp_path),
+    )
+    try:
+        uids = [sup.submit([i + 1, i + 2], 6) for i in range(3)]
+        sup.step()  # two live, one pending
+        chaos.configure("serving-decode:1")
+        done = sup.drain()
+    finally:
+        sup.close()
+    assert sup.restarts == 1
+    assert set(done) == set(uids)
+    reasons = sorted(r.finish_reason for r in done.values())
+    # the pending one shed at drain entry; the two live ones finished through
+    # the restart (replayed, NOT shed a second time)
+    assert reasons == [FINISH_LENGTH, FINISH_LENGTH, FINISH_SHED]
+    with pytest.raises(EngineDrainingError):
+        sup.submit([1], 2)
+
+
+# --------------------------------------------------------------- chaos soak
+
+
+def test_chaos_soak_every_request_accounted(tiny_engine_parts, tmp_path):
+    """The acceptance scenario: all four serving chaos sites armed over a
+    64-request multi-tenant stream with deadlines, a bounded pending queue,
+    and a tight KV pool. Every submitted uid must end in exactly one
+    accountable terminal state and the allocator invariants must hold after
+    every supervised restart."""
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 37, size=int(rng.integers(3, 9))).tolist()
+               for _ in range(64)]
+    budgets = [int(rng.integers(3, 8)) for _ in range(64)]
+    pol = ServingResiliencePolicy(
+        request_ttl_s=300.0, max_pending=16, high_watermark=1.0,
+        low_watermark=0.5, preemption=True,
+    )
+    sup = ServingSupervisor(
+        # 11 usable blocks for 4 slots of sequences up to 16 tokens (4 blocks):
+        # optimistic admission overcommits, serving-alloc pushes it over
+        lambda: _make_engine(tiny_engine_parts, num_slots=4, num_blocks=12, policy=pol),
+        max_restarts=8, backoff_base_s=0.01, wedge_timeout_s=0.5,
+        diagnostics_dir=str(tmp_path),
+    )
+    chaos.configure("serving-prefill:1,serving-decode:1,serving-alloc:2,serving-wedge:1")
+    uids, terminal = [], {}
+    feed = iter(zip(prompts, budgets))
+    seen_restarts = 0
+    try:
+        for step in range(600):
+            for _ in range(8):  # multi-tenant arrival stream, 8 per round
+                nxt = next(feed, None)
+                if nxt is not None:
+                    uids.append(sup.submit(nxt[0], nxt[1]))
+            sup.step()
+            if sup.restarts != seen_restarts:
+                seen_restarts = sup.restarts
+                sup.allocator.check_invariants()  # a rebuilt pool must be sane
+            for uid, req in sup.scheduler.pop_finished().items():
+                assert uid not in terminal, f"uid {uid} finished twice"
+                terminal[uid] = req
+            if len(uids) == 64 and not sup.scheduler.has_work:
+                break
+        else:
+            pytest.fail(f"soak did not settle: {len(terminal)}/{len(uids)} terminal")
+    finally:
+        chaos.configure(None)
+        sup.close()
+
+    # exactly one accountable terminal state per submitted uid
+    assert set(terminal) == set(uids) and len(uids) == 64
+    for uid, req in terminal.items():
+        assert req.finish_reason in TERMINAL_REASONS, (uid, req.finish_reason)
+    # the armed faults actually fired: prefill + decode + wedge each cost one
+    # supervised restart; alloc pressure shows up as preemptions
+    assert sup.restarts >= 3
+    counts = sup.scheduler.outcome_counts()
+    assert counts["shed"] == sum(
+        1 for r in terminal.values() if r.finish_reason == FINISH_SHED)
+    assert counts["shed"] > 0  # 64 arrivals into a 16-deep queue must shed
+    sup.allocator.check_invariants()
+    assert sup.allocator.blocks_in_use == 0
+    sup.export_gauges()
+    assert gauges.get("serving/shed") == float(counts["shed"])
+    assert gauges.get("serving/expired") == float(counts["expired"])
+    assert gauges.get("serving/preempted") == float(counts["preempted"])
+    assert gauges.get("serving/restarts") == float(sup.restarts)
+
+
+# ------------------------------------------------------------------- config
+
+
+def test_train_config_parses_serving_resilience_block():
+    from trlx_tpu.data.configs import ServingResilienceConfig, TrainConfig
+
+    cfg = TrainConfig.from_dict(dict(
+        total_steps=1, batch_size=1, checkpoint_dir="/tmp/x",
+        serving_resilience=dict(
+            enabled=True, request_ttl_s=30.0, max_pending=128,
+            high_watermark=0.9, low_watermark=0.4, max_restarts=5,
+        ),
+    ))
+    svr = cfg.serving_resilience
+    assert isinstance(svr, ServingResilienceConfig)
+    assert svr.enabled and svr.request_ttl_s == 30.0 and svr.max_restarts == 5
+    # default stays off: the resilience layer is opt-in
+    assert TrainConfig.from_dict(dict(
+        total_steps=1, batch_size=1, checkpoint_dir="/tmp/x",
+    )).serving_resilience.enabled is False

@@ -497,6 +497,64 @@ class ServingConfig:
 
 
 @dataclass
+class ServingResilienceConfig:
+    """Serving-grade fault tolerance for the continuous-batching engine
+    (``trlx_tpu/serving/policy.py`` + ``supervisor.py``; docs/serving.md
+    "Fault tolerance"). Only meaningful with ``train.serving.enabled``.
+
+    When enabled, the engine gains per-request deadlines/TTLs (``deadline``
+    outcome), a bounded pending queue with watermark load shedding (``shed``
+    outcome), optimistic admission with KV-block-pressure preemption
+    (re-prefill from host state, zero tokens lost), and a
+    :class:`~trlx_tpu.serving.supervisor.ServingSupervisor` that rebuilds a
+    crashed or wedged engine under a bounded restart budget and replays every
+    live + pending request. Off (the default) keeps the serving path
+    byte-identical to an unconfigured engine.
+
+    :param enabled: master switch for policy + supervisor.
+    :param request_ttl_s: default wall-clock deadline per request from
+        submit; ``None`` = no default TTL.
+    :param max_pending_age_s: cap on time queued before a pending request
+        expires to ``deadline``; ``None`` = unbounded wait.
+    :param max_pending: pending-queue bound driving load shedding; 0 =
+        unbounded (no shedding).
+    :param high_watermark: shed trigger as a fraction of ``max_pending``.
+    :param low_watermark: shed target as a fraction of ``max_pending``.
+    :param preemption: optimistic admission + longest-remaining-first
+        preemption under KV-block pressure; ``False`` keeps worst-case
+        up-front reservation.
+    :param max_restarts: supervised engine restart budget; exceeding it
+        raises with a diagnostics-bundle path in the message (fail closed).
+    :param restart_backoff_base_s: first restart delay; doubles per restart
+        up to ``restart_backoff_max_s``.
+    :param restart_backoff_max_s: backoff ceiling.
+    :param wedge_timeout_s: per-round wedge fallback — abort an engine round
+        that runs this long without finishing (the watchdog escalation on the
+        ``serving-engine`` heartbeat usually fires first). ``None`` disables
+        the fallback.
+    :param diagnostics_dir: directory for restart-budget diagnostics bundles;
+        ``None`` → ``<checkpoint_dir>/diagnostics``.
+    """
+
+    enabled: bool = False
+    request_ttl_s: Optional[float] = None
+    max_pending_age_s: Optional[float] = None
+    max_pending: int = 0
+    high_watermark: float = 1.0
+    low_watermark: float = 0.5
+    preemption: bool = True
+    max_restarts: int = 3
+    restart_backoff_base_s: float = 0.05
+    restart_backoff_max_s: float = 10.0
+    wedge_timeout_s: Optional[float] = 60.0
+    diagnostics_dir: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        return cls(**config)
+
+
+@dataclass
 class TrainConfig:
     """Training loop hyperparameters (parity: ``TrainConfig``, configs.py:10-120 in reference).
 
@@ -570,6 +628,13 @@ class TrainConfig:
     # batching / prefix sharing) — see ServingConfig and docs/serving.md.
     serving: "ServingConfig" = field(default_factory=lambda: ServingConfig())
 
+    # Serving fault tolerance (request deadlines / load shedding / KV-pressure
+    # preemption / supervised engine recovery) — see ServingResilienceConfig
+    # and docs/serving.md "Fault tolerance".
+    serving_resilience: "ServingResilienceConfig" = field(
+        default_factory=lambda: ServingResilienceConfig()
+    )
+
     # score with reward_fn on process 0 only and broadcast the results to every
     # host. None (default) = auto: ON exactly when jax.process_count() > 1 —
     # otherwise every host hits a served reward model with identical requests
@@ -614,6 +679,9 @@ class TrainConfig:
         sv = config.get("serving")
         if isinstance(sv, dict):
             config["serving"] = ServingConfig.from_dict(sv)
+        svr = config.get("serving_resilience")
+        if isinstance(svr, dict):
+            config["serving_resilience"] = ServingResilienceConfig.from_dict(svr)
         return cls(**config)
 
 

@@ -29,7 +29,17 @@ of ``site:arg`` tokens:
   the optimizer step (exercises the TrainingHealthGuard skip/rollback
   ladder);
 - ``bad-element:N`` — one element in each of the next ``N`` scored rollout
-  chunks gets non-finite logprobs (exercises the experience quarantine).
+  chunks gets non-finite logprobs (exercises the experience quarantine);
+- ``serving-prefill:N`` — the next ``N`` serving admission waves raise before
+  their prefill runs (exercises supervised restart + replay of placed
+  requests);
+- ``serving-decode:N`` — the next ``N`` serving decode rounds raise before
+  the device step (exercises restart + replay of live sequences);
+- ``serving-alloc:N`` — the next ``N`` live-sequence KV-block extensions are
+  reported as allocation failures (exercises KV-pressure preemption);
+- ``serving-wedge:N`` — the serving engine's step loop wedges ``N`` times: it
+  stops beating the watchdog and blocks until aborted (exercises the
+  watchdog-escalation / wedge-timer → supervised-restart path).
 
 Count-based sites are *budgets*: each injected fault decrements the budget, so
 ``reward:2`` means exactly two failures then clean behavior — which is exactly
@@ -61,6 +71,10 @@ _COUNT_SITES = (
     "producer-wedge",
     "nan-loss",
     "bad-element",
+    "serving-prefill",
+    "serving-decode",
+    "serving-alloc",
+    "serving-wedge",
 )
 
 

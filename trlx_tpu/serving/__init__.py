@@ -6,12 +6,29 @@ batching + prefix sharing). See docs/serving.md.
 - :mod:`engine` — the device loop: bucketed prefill + fixed-shape paged
   decode step (``trlx_tpu/ops/paged_attention.py``)
 - :mod:`client` — GenerationClient: rollout drop-in + submit/stream/cancel
+- :mod:`policy` — fault-tolerance policy + typed request/engine outcomes
+  (deadlines, load shedding, preemption; docs/serving.md "Fault tolerance")
+- :mod:`supervisor` — ServingSupervisor: supervised engine restarts with
+  request replay under a bounded budget
 """
 
 from trlx_tpu.serving.allocator import PagedBlockAllocator, SeqBlocks
 from trlx_tpu.serving.client import GenerationClient
 from trlx_tpu.serving.engine import ServingEngine
+from trlx_tpu.serving.policy import (
+    EngineDrainingError,
+    EngineStoppedError,
+    EngineWedgedError,
+    RequestExpiredError,
+    RequestShedError,
+    RequestTooLarge,
+    ServingResiliencePolicy,
+)
 from trlx_tpu.serving.scheduler import InflightScheduler, Request
+from trlx_tpu.serving.supervisor import (
+    ServingRestartBudgetExceeded,
+    ServingSupervisor,
+)
 
 __all__ = [
     "PagedBlockAllocator",
@@ -20,4 +37,13 @@ __all__ = [
     "ServingEngine",
     "InflightScheduler",
     "Request",
+    "ServingResiliencePolicy",
+    "RequestTooLarge",
+    "RequestShedError",
+    "RequestExpiredError",
+    "EngineDrainingError",
+    "EngineStoppedError",
+    "EngineWedgedError",
+    "ServingSupervisor",
+    "ServingRestartBudgetExceeded",
 ]

@@ -154,6 +154,25 @@ class PagedBlockAllocator:
             blocks.append(block)
         return SeqBlocks(blocks=blocks, num_shared=num_shared)
 
+    def extend(self, seq: SeqBlocks, total_len: int) -> bool:
+        """Grow a live sequence's reservation to cover ``total_len`` tokens
+        (optimistic-admission mode: blocks are allocated as the sequence
+        grows instead of worst-case up front). Appends exclusive fresh
+        blocks only — the write frontier never enters a shared block, and a
+        decode-time block is never prefix-registered. Returns False without
+        allocating anything when the pool cannot cover the growth (the
+        engine's KV-pressure preemption path takes over)."""
+        need = self.blocks_needed(total_len) - len(seq.blocks)
+        if need <= 0:
+            return True
+        if need > self.free_blocks:
+            return False
+        for _ in range(need):
+            block = self._pop_fresh()
+            self._refcount[block] = 1
+            seq.blocks.append(block)
+        return True
+
     def free(self, seq: SeqBlocks) -> None:
         """Release a sequence's reservation (finish, stop-sequence, or
         cancel): decref every block; blocks reaching refcount 0 either park in

@@ -18,17 +18,24 @@ The client serializes engine stepping: concurrent ``generate_batch`` /
 """
 
 import threading
-from typing import Dict, Iterator, List, Sequence, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from trlx_tpu.ops.generation import pad_to_bucket
 from trlx_tpu.serving.engine import PREFILL_LEN_BUCKETS, ServingEngine
-from trlx_tpu.serving.scheduler import Request
+from trlx_tpu.serving.policy import (
+    EngineStoppedError,
+    RequestExpiredError,
+    RequestShedError,
+)
+from trlx_tpu.serving.scheduler import FINISH_DEADLINE, FINISH_SHED, Request
 
 
 class GenerationClient:
     def __init__(self, engine: ServingEngine):
+        # ``engine`` may also be a ServingSupervisor — same surface, with
+        # crashes absorbed into supervised restarts instead of propagating
         self.engine = engine
         self._step_lock = threading.Lock()
 
@@ -39,8 +46,11 @@ class GenerationClient:
         prompt: Sequence[int],
         max_new_tokens: int,
         stop_sequences: Sequence[Sequence[int]] = (),
+        deadline_s: Optional[float] = None,
     ) -> int:
-        return self.engine.submit(prompt, max_new_tokens, stop_sequences=stop_sequences)
+        return self.engine.submit(
+            prompt, max_new_tokens, stop_sequences=stop_sequences, deadline_s=deadline_s
+        )
 
     def cancel(self, uid: int) -> bool:
         return self.engine.cancel(uid)
@@ -54,7 +64,16 @@ class GenerationClient:
     def stream(self, uid: int) -> Iterator[int]:
         """Yield the request's tokens as the engine produces them, driving
         engine rounds while the request is live. Tokens already decoded when
-        the iterator starts are yielded immediately."""
+        the iterator starts are yielded immediately.
+
+        Liveness: the iterator never spins on a request that can no longer
+        finish. A shed request raises :class:`RequestShedError` and an
+        expired one :class:`RequestExpiredError` (both *after* yielding every
+        token decoded before the terminal state — partial output is part of
+        the accountable outcome); an engine that drained with the request
+        unaccounted raises :class:`EngineStoppedError`. Engine-side failures
+        (e.g. a supervised restart budget exhausting mid-stream) propagate
+        from ``step()`` instead of being swallowed into an infinite loop."""
         req = self._request(uid)
         sent = 0
         while True:
@@ -67,8 +86,22 @@ class GenerationClient:
             with self._step_lock:
                 if not req.done:
                     self.engine.step()
+                    if not req.done and not self.engine.scheduler.has_work:
+                        raise EngineStoppedError(
+                            f"engine drained with request uid={uid} unaccounted "
+                            f"({sent} tokens streamed)"
+                        )
         for tok in req.generated[sent:]:
             yield tok
+        if req.finish_reason == FINISH_SHED:
+            raise RequestShedError(
+                f"request uid={uid} was shed after {len(req.generated)} tokens"
+            )
+        if req.finish_reason == FINISH_DEADLINE:
+            raise RequestExpiredError(
+                f"request uid={uid} expired (deadline_s={req.deadline_s}) "
+                f"after {len(req.generated)} tokens"
+            )
 
     # -- rollout path --------------------------------------------------------
 

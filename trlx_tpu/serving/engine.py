@@ -43,7 +43,14 @@ import jax.numpy as jnp
 
 from trlx_tpu.ops.generation import left_pad_batch, pad_to_bucket
 from trlx_tpu.ops.sampling import sample_token
+from trlx_tpu.resilience.chaos import chaos
 from trlx_tpu.serving.allocator import PagedBlockAllocator
+from trlx_tpu.serving.policy import (
+    EngineDrainingError,
+    EngineWedgedError,
+    RequestTooLarge,
+    ServingResiliencePolicy,
+)
 from trlx_tpu.serving.scheduler import InflightScheduler, Request
 from trlx_tpu.utils import logging
 from trlx_tpu.utils.metrics import gauges
@@ -86,6 +93,7 @@ class ServingEngine:
         min_new_tokens: int = 0,
         prefix_caching: bool = True,
         seed: int = 0,
+        policy: Optional[ServingResiliencePolicy] = None,
     ):
         """``trunk`` is a built ``TransformerLM`` (its config decides the KV
         dtype via ``kv_cache_quant`` and the kernel via
@@ -115,9 +123,20 @@ class ServingEngine:
         self.allocator = PagedBlockAllocator(
             self.num_blocks, self.block_size, prefix_caching=prefix_caching
         )
-        self.scheduler = InflightScheduler(self.num_slots, self.allocator)
+        # fault-tolerance policy (docs/serving.md "Fault tolerance");
+        # None keeps every policy pass a no-op, byte-identical to the
+        # pre-resilience engine
+        self.policy = policy
+        self.scheduler = InflightScheduler(self.num_slots, self.allocator, policy=policy)
         self.stats = ServingStats()
         self._lock = threading.Lock()
+        # graceful shutdown + wedge recovery: drain() flips _draining so
+        # submit() rejects; request_abort() unsticks a wedged step loop.
+        # Both are Events, not flags: submit() and request_abort() run on
+        # client/watchdog threads and must never contend for the engine lock
+        # (held for a whole round — or indefinitely by a wedged one)
+        self._draining = threading.Event()
+        self._abort_evt = threading.Event()
 
         # device state
         self.cache = trunk.init_paged_cache(
@@ -157,7 +176,14 @@ class ServingEngine:
         rng, next_tok = self._sample(rng, logits[:, -1, :], new_counts)
         return next_tok, new_cache, rng
 
-    def _prefill_impl(self, params, ids, mask, rng):
+    def _prefill_impl(self, params, ids, mask, rng, new_counts=None):
+        # ``new_counts=None`` (fresh prompts) keeps the compiled graph
+        # byte-identical to the pre-resilience engine — the zeros fold into
+        # the trace as constants. A wave holding a re-prefilled (preempted or
+        # replayed) request passes its generated-so-far counts as a traced
+        # array so the min_new_tokens eos mask stays consistent across a
+        # re-admission; that compiles a second program, paid only when
+        # preemption/replay actually happens.
         B, P = ids.shape
         cache = self.trunk.init_cache(B, P)
         cache = {**cache, "index": 0}  # static prefill-from-zero marker
@@ -165,7 +191,9 @@ class ServingEngine:
         logits, _, _, cache = self.trunk.apply(
             {"params": params}, ids, mask, positions, cache
         )
-        rng, tok = self._sample(rng, logits[:, -1, :], jnp.zeros((B,), jnp.int32))
+        if new_counts is None:
+            new_counts = jnp.zeros((B,), jnp.int32)
+        rng, tok = self._sample(rng, logits[:, -1, :], new_counts)
         return tok, cache, rng
 
     def _pack_impl(self, pools, cont, rows, lens):
@@ -207,15 +235,30 @@ class ServingEngine:
         prompt: Sequence[int],
         max_new_tokens: int,
         stop_sequences: Sequence[Sequence[int]] = (),
+        deadline_s: Optional[float] = None,
     ) -> int:
+        if self._draining.is_set():
+            raise EngineDrainingError(
+                "engine is draining: new requests are rejected (graceful shutdown)"
+            )
         if len(prompt) + max_new_tokens > self.max_seq_len:
             raise ValueError(
                 f"prompt {len(prompt)} + max_new {max_new_tokens} exceeds "
                 f"engine max_seq_len {self.max_seq_len}"
             )
+        # blocks_needed is pure arithmetic on the immutable block size — no
+        # allocator state is read, so no lock is needed on this thread
+        worst = self.allocator.blocks_needed(len(prompt) + max_new_tokens)  # graftcheck: noqa[CC001]
+        if worst > self.num_blocks - 1:
+            # would pend forever under worst-case admission (and could still
+            # exhaust a lone pool under optimistic admission): reject loudly
+            raise RequestTooLarge(
+                f"request needs {worst} KV blocks worst-case but the pool "
+                f"holds {self.num_blocks - 1}: it can never be admitted"
+            )
         return self.scheduler.submit(
             prompt, max_new_tokens, eos_token_id=self.eos_token_id,
-            stop_sequences=stop_sequences,
+            stop_sequences=stop_sequences, deadline_s=deadline_s,
         )
 
     def cancel(self, uid: int) -> bool:
@@ -243,18 +286,25 @@ class ServingEngine:
         finished: List[Request] = []
         for slot in self.scheduler.reap_cancelled():
             self._free_slot_state(slot)
+        # admission-side policy pass: expire + shed pending before placement
+        # (terminated requests never held device state, so nothing to free)
+        finished.extend(self.scheduler.expire_and_shed_pending())
         placements = self.scheduler.admissions()
         if not placements:
             return finished
-        # group by bucketed prompt length so one wave compiles per bucket pair
+        # placed requests hold slots + blocks now; a crash here is the
+        # supervisor's replay case (live requests re-queued onto a new engine)
+        chaos.fail_if_armed("serving-prefill", f"{len(placements)} placements")
+        # group by bucketed prefill length so one wave compiles per bucket
+        # pair; prefill covers prompt + generated-so-far (re-admissions)
         by_bucket: Dict[int, List[Tuple[int, Request]]] = {}
         for slot, req in placements:
             by_bucket.setdefault(
-                pad_to_bucket(len(req.prompt), PREFILL_LEN_BUCKETS), []
+                pad_to_bucket(len(req.prefill_ids), PREFILL_LEN_BUCKETS), []
             ).append((slot, req))
         for P_b, group in sorted(by_bucket.items()):
             n_b = _pow2_at_least(len(group), self.num_slots)
-            ids_list = [np.asarray(req.prompt, np.int32) for _, req in group]
+            ids_list = [np.asarray(req.prefill_ids, np.int32) for _, req in group]
             ids, mask = left_pad_batch(ids_list, self.pad_token_id, P_b)
             if n_b > len(group):  # pad the wave to its batch bucket
                 ids = np.concatenate(
@@ -268,16 +318,20 @@ class ServingEngine:
                 # uniform) but a zero-length cumsum position underflows the
                 # learned table on some configs; give them token 0 @ pos 0
                 mask[len(group):, -1] = 1
+            counts = np.zeros((n_b,), np.int32)
+            for i, (_, req) in enumerate(group):
+                counts[i] = len(req.generated)
             tok, cont, self._rng = self._prefill(
                 self.params,  # graftcheck: noqa[TH001] — under step()'s lock
                 jnp.asarray(ids), jnp.asarray(mask), self._rng,
+                jnp.asarray(counts) if counts.any() else None,
             )
             rows = np.zeros((n_b, self.max_blocks_per_seq), np.int32)
             lens = np.zeros((n_b,), np.int32)
             for i, (slot, req) in enumerate(group):
                 blocks = req.seq_blocks.blocks
                 rows[i, : len(blocks)] = blocks
-                lens[i] = len(req.prompt)
+                lens[i] = len(req.prefill_ids)
             pools = {
                 k: v for k, v in self.cache.items()
                 if k not in ("block_tables", "context_lens")
@@ -287,10 +341,10 @@ class ServingEngine:
             self.cache.update(packed)
             tok_np = np.asarray(jax.device_get(tok))
             self.stats.prefill_waves += 1
-            self.stats.prefill_tokens += int(sum(len(r.prompt) for _, r in group))
+            self.stats.prefill_tokens += int(sum(len(r.prefill_ids) for _, r in group))
             for i, (slot, req) in enumerate(group):
                 self._tables[slot] = rows[i]
-                self._lens[slot] = len(req.prompt)
+                self._lens[slot] = len(req.prefill_ids)
                 self._pending_tok[slot] = tok_np[i]
                 self._tables_dirty = True
                 done = self.scheduler.on_token(slot, int(tok_np[i]))
@@ -299,13 +353,74 @@ class ServingEngine:
                     self._free_slot_state(slot)
         return finished
 
+    def _pick_victim(self, exclude: int) -> Optional[int]:
+        """Preemption victim: the live sequence with the most decode budget
+        left (longest-remaining first — it would hold its blocks longest, and
+        re-prefilling it re-caches the fewest finished tokens per block
+        freed). Never the slot we're trying to grow."""
+        best, best_remaining = None, -1
+        for slot, req in enumerate(self.scheduler.slots):
+            if req is None or slot == exclude:
+                continue
+            if req.remaining_tokens > best_remaining:
+                best, best_remaining = slot, req.remaining_tokens
+        return best
+
+    def _ensure_decode_capacity(self) -> None:
+        """Optimistic-admission mode: before the decode step, every live slot
+        must own a block covering this round's write position. Growth comes
+        from ``allocator.extend``; when the pool can't serve it, preempt
+        victims (longest-remaining first) until it can. ``serving-alloc``
+        chaos reports one extension as failed to drive this path on demand."""
+        if self.policy is None or not self.policy.preemption:
+            return
+        for slot, req in enumerate(self.scheduler.slots):
+            if req is None:
+                continue
+            need_len = int(self._lens[slot]) + 1  # the incoming token's KV
+            before = len(req.seq_blocks.blocks)
+            ok = (not chaos.should_fail("serving-alloc")) and self.allocator.extend(
+                req.seq_blocks, need_len
+            )
+            while not ok:
+                victim = self._pick_victim(exclude=slot)
+                if victim is not None:
+                    logger.warning(
+                        f"kv pressure: preempting uid={self.scheduler.slots[victim].uid} "
+                        f"(slot {victim}) to grow slot {slot}"
+                    )
+                    self.scheduler.preempt(victim)
+                    self._free_slot_state(victim)
+                ok = self.allocator.extend(req.seq_blocks, need_len)
+                if not ok and victim is None:
+                    # submit() bounds every request's worst case to the pool,
+                    # so a lone sequence can always extend; reaching here
+                    # means the pool accounting broke — fail to the supervisor
+                    raise RuntimeError(
+                        f"kv pool cannot cover lone slot {slot} at len {need_len}"
+                    )
+            if len(req.seq_blocks.blocks) != before:
+                self._tables[slot, : len(req.seq_blocks.blocks)] = req.seq_blocks.blocks
+                self._tables_dirty = True
+
     def _decode_round(self) -> List[Request]:
+        finished: List[Request] = []
+        for slot, req in self.scheduler.expire_live():
+            self._free_slot_state(slot)
+            finished.append(req)
+        self._ensure_decode_capacity()
         live = [s for s, r in enumerate(self.scheduler.slots) if r is not None]
         if not live:
-            return []
+            return finished
+        chaos.fail_if_armed("serving-decode", f"{len(live)} live slots")
         if self._tables_dirty:
-            self.cache["block_tables"] = jnp.asarray(self._tables)
-            self.cache["context_lens"] = jnp.asarray(self._lens)
+            # push COPIES of the host mirrors: jnp.asarray may zero-copy an
+            # aligned numpy buffer on CPU, and the mirrors are mutated in
+            # place (``self._lens += 1`` below, slot frees) while the
+            # dispatched step may still be reading the aliased device buffer
+            # — an intermittent corruption under async dispatch
+            self.cache["block_tables"] = jnp.asarray(np.array(self._tables))
+            self.cache["context_lens"] = jnp.asarray(np.array(self._lens))
             self._tables_dirty = False
         new_counts = np.array(
             [len(r.generated) if r is not None else 0 for r in self.scheduler.slots],
@@ -320,7 +435,6 @@ class ServingEngine:
         # step needs no host->device sync
         self._lens += 1
         tok_np = np.asarray(jax.device_get(next_tok))
-        finished: List[Request] = []
         for slot in live:
             self._pending_tok[slot] = tok_np[slot]
             done = self.scheduler.on_token(slot, int(tok_np[slot]))
@@ -332,15 +446,61 @@ class ServingEngine:
         self.stats.delivered_tokens += len(live)
         return finished
 
+    def request_abort(self) -> None:
+        """Unstick a wedged step loop (called by the watchdog escalation or
+        the supervisor's per-round wedge timer, from their own threads).
+        Event.set() is internally synchronized — taking the engine lock here
+        would deadlock against the wedged step this call exists to abort."""
+        self._abort_evt.set()  # graftcheck: noqa[TH001]
+
     def step(self) -> List[Request]:
         """One engine round: admissions (bucketed prefill) + one decode step.
         Returns requests finished during the round."""
         with self._lock:
+            if chaos.should_fail("serving-wedge"):
+                # model a wedged device loop: no heartbeat, no exception, no
+                # progress — parked until someone aborts it (watchdog
+                # escalation or the supervisor's wedge timer)
+                logger.warning("chaos: serving step wedged, waiting for abort")
+                # blocking under the engine lock is the POINT: a wedged
+                # device call holds the lock exactly like this, and recovery
+                # (request_abort) must work without ever taking it
+                self._abort_evt.wait()  # graftcheck: noqa[CC005]
+                self._abort_evt.clear()
+                raise EngineWedgedError("engine step loop wedged and was aborted")
             finished = self._admit()
             finished += self._decode_round()
             for req in finished:
                 self.stats.finished_requests += 1
+                if req.latency_s is not None:
+                    gauges.observe("serving/request_latency_s", req.latency_s)
             return finished
+
+    def begin_drain(self, shed_pending: bool = True) -> None:
+        """Enter drain mode: reject new submits. ``shed_pending=False`` is the
+        supervisor's mid-drain-restart case — the replay queue holds requests
+        that were *live* and must finish, not be shed a second time."""
+        self._draining.set()
+        if shed_pending:
+            self.scheduler.shed_all_pending()
+
+    def drain(self) -> Dict[int, Request]:
+        """Graceful shutdown: stop admitting new submits
+        (:class:`EngineDrainingError`), shed everything still pending with an
+        accountable ``shed`` outcome, and drive rounds until the live slots
+        finish. Returns every request that reached a terminal state during
+        the drain (preempted sequences re-enter and finish too)."""
+        self.begin_drain()
+        done: Dict[int, Request] = dict(self.scheduler.pop_finished())
+        while self.scheduler.has_work:  # live slots + preemption re-queues
+            self.step()
+            done.update(self.scheduler.pop_finished())
+        return done
+
+    def adopt(self, state: Dict[str, object]) -> None:
+        """Install a dead predecessor's exported request state (supervised
+        restart): see :meth:`InflightScheduler.adopt_state`."""
+        self.scheduler.adopt_state(state)
 
     def run(self, uids: Optional[Sequence[int]] = None) -> Dict[int, Request]:
         """Drive rounds until the given uids (or all work) complete."""
@@ -378,6 +538,9 @@ class ServingEngine:
         out["mean_slot_occupancy"] = self.scheduler.mean_slot_occupancy
         out["prefix_cache_hit_rate"] = self.allocator.stats.hit_rate
         out["blocks_in_use"] = float(self.allocator.blocks_in_use)
+        out["pending_depth"] = float(self.scheduler.pending_depth)
+        for key, count in self.scheduler.outcome_counts().items():
+            out[key] = float(count)
         return out
 
     def export_gauges(self) -> None:
@@ -387,3 +550,7 @@ class ServingEngine:
         gauges.set("serving/blocks_in_use", s["blocks_in_use"])
         gauges.set("serving/delivered_tokens", s["delivered_tokens"])
         gauges.set("serving/finished_requests", s["finished_requests"])
+        gauges.set("serving/pending_depth", s["pending_depth"])
+        gauges.set("serving/shed", s["shed"])
+        gauges.set("serving/expired", s["expired"])
+        gauges.set("serving/preempted", s["preempted"])

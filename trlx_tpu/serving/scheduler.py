@@ -12,19 +12,35 @@ only placed when its worst-case block reservation (prompt + max_new) fits,
 so a live sequence can never hit an allocation failure mid-flight. Pending
 requests are sorted by prompt length at each round so one admission wave
 prefills in a few tight buckets instead of one ragged batch.
+
+With a :class:`~trlx_tpu.serving.policy.ServingResiliencePolicy` installed
+the scheduler also runs the request-level fault-tolerance passes
+(docs/serving.md "Fault tolerance"): pending/live deadline expiry
+(``deadline`` outcome), watermark load shedding (``shed``), optimistic
+admission with KV-pressure preemption re-queueing, and the export/adopt
+replay seam the :class:`~trlx_tpu.serving.supervisor.ServingSupervisor`
+uses to move accepted requests onto a rebuilt engine. Without a policy every
+pass is a no-op and behavior is byte-identical to the original engine.
 """
 
 import itertools
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from trlx_tpu.serving.allocator import PagedBlockAllocator, SeqBlocks
+from trlx_tpu.serving.policy import ServingResiliencePolicy
 
 FINISH_EOS = "eos"
 FINISH_STOP = "stop_sequence"
 FINISH_LENGTH = "length"
 FINISH_CANCELLED = "cancelled"
+# fault-tolerance terminal states (docs/serving.md "Fault tolerance"): a
+# request past its TTL/deadline, and one shed under admission pressure or
+# drain. Both are accountable — they land in `finished` like any other end.
+FINISH_DEADLINE = "deadline"
+FINISH_SHED = "shed"
 
 
 @dataclass
@@ -36,21 +52,56 @@ class Request:
     max_new_tokens: int
     eos_token_id: Optional[int] = None
     stop_sequences: Tuple[Tuple[int, ...], ...] = ()
+    # wall-clock accounting (scheduler clock, monotonic seconds): deadline_s
+    # is a TTL from submit; None = no deadline for this request
+    submitted_at: float = 0.0
+    deadline_s: Optional[float] = None
+    finished_at: Optional[float] = None
     # -- filled in by the scheduler/engine --
     generated: List[int] = field(default_factory=list)
     finish_reason: Optional[str] = None
     seq_blocks: Optional[SeqBlocks] = None
     slot: Optional[int] = None
+    preemptions: int = 0
 
     @property
     def done(self) -> bool:
         return self.finish_reason is not None
 
+    @property
+    def prefill_ids(self) -> List[int]:
+        """Tokens to prefill on (re-)admission: the prompt plus everything
+        generated so far — a preempted or replayed request re-enters the
+        cache from host-side state, losing nothing."""
+        return self.prompt + self.generated
+
+    @property
+    def remaining_tokens(self) -> int:
+        """Decode budget left (the preemption victim metric)."""
+        return self.max_new_tokens - len(self.generated)
+
+    def past_deadline(self, now: float) -> bool:
+        return self.deadline_s is not None and now - self.submitted_at > self.deadline_s
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        return None if self.finished_at is None else self.finished_at - self.submitted_at
+
 
 class InflightScheduler:
-    def __init__(self, num_slots: int, allocator: PagedBlockAllocator):
+    def __init__(
+        self,
+        num_slots: int,
+        allocator: PagedBlockAllocator,
+        policy: Optional[ServingResiliencePolicy] = None,
+        clock=time.monotonic,
+    ):
         self.num_slots = num_slots
         self.allocator = allocator
+        # fault-tolerance policy (deadlines / shedding / optimistic
+        # admission); None = the PR 8 behavior, byte-identical
+        self.policy = policy
+        self.clock = clock
         self._uid = itertools.count()
         self._lock = threading.Lock()
         self._pending: List[Request] = []
@@ -63,6 +114,14 @@ class InflightScheduler:
         # occupancy accounting for the obs gauge: live slots integrated over steps
         self.steps = 0
         self.occupied_slot_steps = 0
+        # fault-tolerance outcome counters (written under _lock or on the
+        # engine thread; exported through engine gauges)
+        self.shed_count = 0
+        self.expired_count = 0
+        self.preempted_count = 0
+        # highest uid ever issued + 1: a successor scheduler (supervised
+        # restart) resumes the counter here so client-held uids stay unique
+        self.uid_hwm = 0
 
     # -- request intake (thread-safe: rollout producers submit from their own
     # threads while the engine loop drains) --------------------------------
@@ -73,17 +132,26 @@ class InflightScheduler:
         max_new_tokens: int,
         eos_token_id: Optional[int] = None,
         stop_sequences: Sequence[Sequence[int]] = (),
+        deadline_s: Optional[float] = None,
     ) -> int:
-        req = Request(
-            uid=next(self._uid),
-            prompt=list(map(int, prompt)),
-            max_new_tokens=int(max_new_tokens),
-            eos_token_id=eos_token_id,
-            stop_sequences=tuple(tuple(map(int, s)) for s in stop_sequences if len(s)),
-        )
+        if deadline_s is None and self.policy is not None:
+            deadline_s = self.policy.request_ttl_s
         with self._lock:
+            # the uid draw stays under the lock: adopt_state() re-seats the
+            # counter on a supervised restart, and a submit racing that swap
+            # must not draw from the retired counter
+            req = Request(
+                uid=next(self._uid),
+                prompt=list(map(int, prompt)),
+                max_new_tokens=int(max_new_tokens),
+                eos_token_id=eos_token_id,
+                stop_sequences=tuple(tuple(map(int, s)) for s in stop_sequences if len(s)),
+                submitted_at=self.clock(),
+                deadline_s=deadline_s,
+            )
             self._pending.append(req)
             self.requests[req.uid] = req
+            self.uid_hwm = max(self.uid_hwm, req.uid + 1)
         return req.uid
 
     def cancel(self, uid: int) -> bool:
@@ -94,6 +162,7 @@ class InflightScheduler:
                 if req.uid == uid:
                     self._pending.pop(i)
                     req.finish_reason = FINISH_CANCELLED
+                    req.finished_at = self.clock()
                     self.finished[uid] = req
                     return True
             self._cancelled.add(uid)
@@ -130,18 +199,125 @@ class InflightScheduler:
         with self._lock:
             return self.requests.pop(uid, None)
 
+    @property
+    def pending_depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
     # -- engine-side rounds --------------------------------------------------
 
     def _finish(self, slot: int, reason: str) -> Request:
         req = self.slots[slot]
         self.slots[slot] = None
         req.finish_reason = reason
+        req.finished_at = self.clock()
         if req.seq_blocks is not None:
             self.allocator.free(req.seq_blocks)
             req.seq_blocks = None
         req.slot = None
         with self._lock:  # `finished` is also written by producer-side cancel()
             self.finished[req.uid] = req
+        return req
+
+    # -- fault-tolerance rounds (no-ops without a policy) --------------------
+
+    def expire_and_shed_pending(self) -> List[Request]:
+        """One admission-side policy pass: expire pending requests past their
+        deadline or ``max_pending_age_s``, then shed the oldest pending
+        requests while the queue is over its high watermark (down to the low
+        watermark). Every outcome is accountable — terminated requests land
+        in ``finished`` exactly like eos/length ends. Returns them."""
+        policy = self.policy
+        if policy is None:
+            return []
+        now = self.clock()
+        out: List[Request] = []
+        with self._lock:
+            kept: List[Request] = []
+            for req in self._pending:
+                age = now - req.submitted_at
+                expired = req.past_deadline(now) or (
+                    policy.max_pending_age_s is not None
+                    and age > policy.max_pending_age_s
+                )
+                if expired:
+                    req.finish_reason = FINISH_DEADLINE
+                    req.finished_at = now
+                    self.finished[req.uid] = req
+                    self.expired_count += 1
+                    out.append(req)
+                else:
+                    kept.append(req)
+            self._pending = kept
+            trigger = policy.shed_trigger
+            if trigger and len(self._pending) > trigger:
+                # oldest-first: they have waited longest and are closest to
+                # expiring anyway; preserve submit order among the survivors
+                by_age = sorted(self._pending, key=lambda r: r.submitted_at)
+                to_shed = set(
+                    id(r) for r in by_age[: len(self._pending) - policy.shed_target]
+                )
+                kept = []
+                for req in self._pending:
+                    if id(req) in to_shed:
+                        req.finish_reason = FINISH_SHED
+                        req.finished_at = now
+                        self.finished[req.uid] = req
+                        self.shed_count += 1
+                        out.append(req)
+                    else:
+                        kept.append(req)
+                self._pending = kept
+        return out
+
+    def shed_all_pending(self) -> List[Request]:
+        """Drain mode: terminate every pending request with the accountable
+        ``shed`` outcome (they were accepted; silently dropping them would
+        strand their clients). Live slots are untouched — drain lets them
+        finish."""
+        now = self.clock()
+        with self._lock:
+            pending, self._pending = self._pending, []
+            for req in pending:
+                req.finish_reason = FINISH_SHED
+                req.finished_at = now
+                self.finished[req.uid] = req
+                self.shed_count += 1
+        return pending
+
+    def expire_live(self) -> List[Tuple[int, Request]]:
+        """Finish live sequences past their deadline (reason ``deadline``).
+        Returns ``(freed slot, request)`` pairs — the engine zeroes the slots'
+        device state and counts the requests as finished this round."""
+        if self.policy is None:
+            return []
+        now = self.clock()
+        freed = []
+        for slot, req in enumerate(self.slots):
+            if req is not None and req.past_deadline(now):
+                freed.append((slot, self._finish(slot, FINISH_DEADLINE)))
+        if freed:
+            with self._lock:  # counters are read by gauge/bench threads
+                self.expired_count += len(freed)
+        return freed
+
+    def preempt(self, slot: int) -> Request:
+        """Evict a live sequence under KV-block pressure: free its blocks,
+        keep its host-side state (prompt + generated-so-far), and re-queue it
+        at the *front* of the pending queue for re-prefill — it already waited
+        once and holds partial output, so it outranks fresh arrivals. The
+        request stays non-terminal; nothing is lost."""
+        req = self.slots[slot]
+        assert req is not None, f"preempting empty slot {slot}"
+        self.slots[slot] = None
+        if req.seq_blocks is not None:
+            self.allocator.free(req.seq_blocks)
+            req.seq_blocks = None
+        req.slot = None
+        req.preemptions += 1
+        with self._lock:
+            self.preempted_count += 1
+            self._pending.insert(0, req)
         return req
 
     def reap_cancelled(self) -> List[int]:
@@ -170,16 +346,27 @@ class InflightScheduler:
         # sit in the producer-facing critical section
         with self._lock:
             pending, self._pending = self._pending, []
-        pending.sort(key=lambda r: len(r.prompt))
+        # sort on the actual prefill length (prompt + replayed generation for
+        # a preempted request) so waves bucket tightly; stable sort keeps a
+        # re-queued preemption ahead of fresh arrivals of the same length
+        pending.sort(key=lambda r: len(r.prefill_ids))
+        optimistic = self.policy is not None and self.policy.preemption
         placements: List[Tuple[int, Request]] = []
         kept: List[Request] = []
         for req in pending:
             if not free:
                 kept.append(req)
                 continue
-            seq = self.allocator.allocate(
-                req.prompt, len(req.prompt) + req.max_new_tokens
+            prefill = req.prefill_ids
+            # optimistic mode reserves only the prefill plus the next decode
+            # write; growth is paid per round via allocator.extend, with the
+            # engine's preemption path absorbing pressure. Default mode keeps
+            # the PR 8 worst-case reservation (mid-flight pressure impossible)
+            reserve = (
+                len(prefill) + 1 if optimistic
+                else len(req.prompt) + req.max_new_tokens
             )
+            seq = self.allocator.allocate(prefill, reserve)
             if seq is None:
                 kept.append(req)  # capacity-blocked; retry next round
                 continue
@@ -210,6 +397,56 @@ class InflightScheduler:
             return self._finish(slot, FINISH_LENGTH)
         return None
 
+    # -- supervised replay ---------------------------------------------------
+
+    def export_state(self) -> Dict[str, object]:
+        """Snapshot every piece of host-side request state a successor engine
+        needs (supervisor restart): live requests fold back into the replay
+        queue — their device blocks died with the old engine, but the prompt
+        and generated-so-far live here, so re-prefill loses nothing. Called
+        on the engine-driving thread after the step loop has raised, so the
+        slot array is quiescent."""
+        live = [r for r in self.slots if r is not None]
+        for req in live:
+            # blocks belong to the dead allocator; drop the handles so the
+            # successor re-allocates from its own pool
+            req.seq_blocks = None
+            req.slot = None
+        with self._lock:
+            pending = list(self._pending)
+            state = {
+                "replay": live + pending,
+                "finished": dict(self.finished),
+                "requests": dict(self.requests),
+                "cancelled": set(self._cancelled),
+                "uid_hwm": self.uid_hwm,
+                "counters": (
+                    self.shed_count, self.expired_count, self.preempted_count,
+                    self.steps, self.occupied_slot_steps,
+                ),
+            }
+        return state
+
+    def adopt_state(self, state: Dict[str, object]) -> None:
+        """Install a predecessor's exported state (see :meth:`export_state`):
+        replayed requests enter the pending queue ahead of anything already
+        submitted to this engine, uid continuity is preserved (a client-held
+        uid must never be reissued), and outcome counters stay cumulative
+        across engine generations."""
+        with self._lock:
+            self._uid = itertools.count(state["uid_hwm"])
+            self.uid_hwm = state["uid_hwm"]
+            self.requests.update(state["requests"])
+            self.finished.update(state["finished"])
+            self._cancelled |= state["cancelled"]
+            self._pending = list(state["replay"]) + self._pending
+            shed, expired, preempted, steps, occupied = state["counters"]
+            self.shed_count += shed
+            self.expired_count += expired
+            self.preempted_count += preempted
+            self.steps += steps
+            self.occupied_slot_steps += occupied
+
     def note_step(self) -> None:
         # locked: the occupancy gauge (bench/obs threads) reads these counters
         # while the engine loop advances them
@@ -223,3 +460,12 @@ class InflightScheduler:
         with self._lock:
             steps, occupied = self.steps, self.occupied_slot_steps
         return occupied / max(1, steps) / max(1, self.num_slots)
+
+    def outcome_counts(self) -> Dict[str, int]:
+        """Fault-tolerance outcome counters (locked snapshot for gauges)."""
+        with self._lock:
+            return {
+                "shed": self.shed_count,
+                "expired": self.expired_count,
+                "preempted": self.preempted_count,
+            }

@@ -520,7 +520,12 @@ class PPOTrainer(MeshRLTrainer):
             return fallback("decode-time logits processor in use")
 
         from trlx_tpu.models.transformer import TransformerLM
-        from trlx_tpu.serving import GenerationClient, ServingEngine
+        from trlx_tpu.serving import (
+            GenerationClient,
+            ServingEngine,
+            ServingResiliencePolicy,
+            ServingSupervisor,
+        )
 
         gen_kwargs = dict(self.generate_experience_kwargs or self.generate_kwargs)
         gen_kwargs.setdefault("eos_token_id", self.tokenizer.eos_token_id)
@@ -547,25 +552,57 @@ class PPOTrainer(MeshRLTrainer):
         # prompts are admitted unpadded, so capacity only needs the real
         # prompt lengths (<= seq_length) plus the decode budget
         max_seq_len = self.config.train.seq_length + self._serving_max_new
-        self._serving_engine = ServingEngine(
-            TransformerLM(trunk_config),
-            None,  # snapshot installed per rollout phase in _serving_generate
-            num_slots=num_slots,
-            max_seq_len=max_seq_len,
-            block_size=cfg.block_size,
-            num_blocks=cfg.num_blocks,
-            eos_token_id=eos,
-            pad_token_id=pad,
-            gen_kwargs=gen_kwargs,
-            min_new_tokens=self._serving_min_new,
-            prefix_caching=cfg.prefix_caching,
-            seed=self.config.train.seed + 17,
-        )
+        svr = self.config.train.serving_resilience
+        policy = None
+        if svr.enabled:
+            policy = ServingResiliencePolicy(
+                request_ttl_s=svr.request_ttl_s,
+                max_pending_age_s=svr.max_pending_age_s,
+                max_pending=svr.max_pending,
+                high_watermark=svr.high_watermark,
+                low_watermark=svr.low_watermark,
+                preemption=svr.preemption,
+            )
+
+        def build_engine():
+            return ServingEngine(
+                TransformerLM(trunk_config),
+                None,  # snapshot installed per rollout phase in _serving_generate
+                num_slots=num_slots,
+                max_seq_len=max_seq_len,
+                block_size=cfg.block_size,
+                num_blocks=cfg.num_blocks,
+                eos_token_id=eos,
+                pad_token_id=pad,
+                gen_kwargs=gen_kwargs,
+                min_new_tokens=self._serving_min_new,
+                prefix_caching=cfg.prefix_caching,
+                seed=self.config.train.seed + 17,
+                policy=policy,
+            )
+
+        if svr.enabled:
+            # supervised: crashes/wedges rebuild the engine (same factory
+            # args) and replay every accepted request — docs/serving.md
+            diag = svr.diagnostics_dir or os.path.join(
+                self.config.train.checkpoint_dir, "diagnostics"
+            )
+            self._serving_engine = ServingSupervisor(
+                build_engine,
+                max_restarts=svr.max_restarts,
+                backoff_base_s=svr.restart_backoff_base_s,
+                backoff_max_s=svr.restart_backoff_max_s,
+                wedge_timeout_s=svr.wedge_timeout_s,
+                diagnostics_dir=diag,
+            )
+        else:
+            self._serving_engine = build_engine()
         self._serving_client = GenerationClient(self._serving_engine)
         logger.info(
             f"serving engine enabled: slots={num_slots}, "
             f"block_size={cfg.block_size}, blocks={self._serving_engine.num_blocks}, "
-            f"int8_kv={trunk_config.kv_cache_quant}, impl={cfg.attention_impl}"
+            f"int8_kv={trunk_config.kv_cache_quant}, impl={cfg.attention_impl}, "
+            f"resilience={'on' if svr.enabled else 'off'}"
         )
 
     def _serving_generate(self, prompts, params=None):
