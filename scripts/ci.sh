@@ -120,6 +120,29 @@ echo "== serving fault-tolerance tests (CPU)"
 JAX_PLATFORMS=cpu timeout -k 10 600 \
     python -m pytest tests/test_serving_resilience.py -q -m "not slow" -p no:cacheprovider
 
+echo "== serving speculative-decode tests (CPU)"
+# speculative decoding + chunked prefill: verify-kernel parity (q_len 1..K),
+# greedy bit-parity of the spec path vs the one-shot reference (bf16/int8),
+# accept accounting, anti-starvation aging, preemption replaying accepted
+# draft tokens; bounded so a diverging accept loop fails fast
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_serving_spec.py -q -m "not slow" -p no:cacheprovider
+
+echo "== serving spec seeded-regression gate (accept_all must break parity)"
+# the spec gate proves itself the way the conc/IR gates do: force every draft
+# accepted (TRLX_SPEC_SEED_REGRESSION=accept_all bypasses the accept rule)
+# and require the greedy-parity tests to FAIL — a parity harness that passes
+# under unconditional acceptance is not checking the accept rule. The
+# accept_all self-test inside the suite asserts the same thing inline; this
+# gate asserts it end-to-end through the real pytest command.
+if JAX_PLATFORMS=cpu TRLX_SPEC_SEED_REGRESSION=accept_all timeout -k 10 600 \
+    python -m pytest tests/test_serving_spec.py -q -k "parity and not accept_all" \
+    -p no:cacheprovider > /dev/null 2>&1; then
+    echo "FATAL: seeded accept_all regression was NOT caught by the spec parity gate" >&2
+    exit 1
+fi
+echo "seeded accept_all correctly rejected"
+
 echo "== serving seeded-wedge gate (must recover in exactly one restart)"
 # the serving gate proves itself the same way the conc gate does: arm the
 # wedge chaos site from the environment and require the supervisor to detect

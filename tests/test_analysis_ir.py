@@ -478,3 +478,18 @@ def test_decode_step_lowers_at_xl_scale():
     assert hidden == 1600
     text = lowered.lowered.as_text()
     assert "stablehlo" in text or "module" in text
+
+
+@pytest.mark.slow
+def test_spec_verify_step_lowers_at_xl_scale():
+    # the speculative-verify evidence beyond gpt2-small: the GPT-2-XL-shaped
+    # verify step (stacked scan_layers pools, int8 KV, K+1 query positions)
+    # traces and lowers devicelessly. Lower-only, same reasoning as above.
+    ep = load_all()["spec_verify_step"]
+    assert {"small", "xl"} <= set(ep.specs)
+    lowered = lower_entry(ep, spec="xl", compile=False)
+    assert lowered.compiled is None
+    assert lowered.artifacts.meta.get("hidden_size") == 1600
+    assert lowered.artifacts.meta.get("spec_k", 0) > 0
+    text = lowered.lowered.as_text()
+    assert "stablehlo" in text or "module" in text

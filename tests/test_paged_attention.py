@@ -16,7 +16,11 @@ from trlx_tpu.ops.paged_attention import (
     paged_attention_pallas,
     paged_attention_xla,
     paged_decode_attention,
+    paged_verify_attention,
+    paged_verify_attention_pallas,
+    paged_verify_attention_xla,
     write_paged_kv,
+    write_paged_kv_multi,
 )
 
 pytestmark = pytest.mark.serving
@@ -233,6 +237,155 @@ def test_paged_decode_matches_contiguous_greedy(quant):
         )
         got.append(int(jnp.argmax(logits[0, -1])))
     assert got == ref
+
+
+# ---------------------------------------------------------- verify widening
+
+
+def _dense_verify_reference(q, k_pool, v_pool, tables, lens, k_scale=None,
+                            v_scale=None):
+    """[B, Q, H, D] verify attention, one dense softmax per (slot, query,
+    head): query j sees positions < lens[b] + j + 1."""
+    B, Q, H, D = q.shape
+    qf = np.asarray(q, np.float64)
+    kd = np.asarray(k_pool, np.float64)[np.asarray(tables)].reshape(B, MB * BS, HKV, D)
+    vd = np.asarray(v_pool, np.float64)[np.asarray(tables)].reshape(B, MB * BS, HKV, D)
+    if k_scale is not None:
+        ks = np.asarray(k_scale, np.float64)[np.asarray(tables)].reshape(B, MB * BS, HKV)
+        vs = np.asarray(v_scale, np.float64)[np.asarray(tables)].reshape(B, MB * BS, HKV)
+    out = np.zeros((B, Q, H, D))
+    for b in range(B):
+        for j in range(Q):
+            L = int(lens[b]) + j + 1
+            for h in range(H):
+                kh = h // REP
+                scores = kd[b, :L, kh] @ qf[b, j, h] / np.sqrt(D)
+                if k_scale is not None:
+                    scores = scores * ks[b, :L, kh]
+                p = np.exp(scores - scores.max())
+                p /= p.sum()
+                if v_scale is not None:
+                    p = p * vs[b, :L, kh]
+                out[b, j, h] = p @ vd[b, :L, kh]
+    return out
+
+
+@pytest.mark.parametrize("Q", [1, 2, 3, 4])
+@pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8kv"])
+def test_verify_xla_matches_pallas_and_dense(quant, Q):
+    """The spec_verify contract across q_len 1..K: XLA widening, fused Pallas
+    verify kernel (interpret mode), and the dense reference agree for both
+    pool layouts."""
+    rng = np.random.default_rng(5)
+    k_pool, v_pool, k_scale, v_scale, tables, lens, kraw, vraw = _make_pools(rng, quant)
+    lens = np.array([9, 5, 2], np.int32)  # room for Q appended positions
+    q = jnp.asarray(rng.standard_normal((B, Q, HKV * REP, D)).astype(np.float32))
+    kw = dict(
+        k_scale=None if k_scale is None else jnp.asarray(k_scale),
+        v_scale=None if v_scale is None else jnp.asarray(v_scale),
+    )
+    ref = _dense_verify_reference(q, kraw, vraw, tables, lens, k_scale, v_scale)
+    out_xla = paged_verify_attention_xla(
+        q, k_pool, v_pool, jnp.asarray(tables), jnp.asarray(lens), **kw
+    )
+    out_pl = paged_verify_attention_pallas(
+        q, k_pool, v_pool, jnp.asarray(tables), jnp.asarray(lens),
+        interpret=True, **kw
+    )
+    np.testing.assert_allclose(np.asarray(out_xla), ref, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out_pl), ref, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(out_xla), np.asarray(out_pl), rtol=1e-5, atol=1e-5
+    )
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8kv"])
+def test_verify_q1_bit_identical_to_decode_path(quant):
+    """Q=1 verify with pre-append lens must reproduce the single-token decode
+    entry BIT-FOR-BIT (decode passes the post-write count lens+1) — the
+    spec_k=0-equivalence anchor: both fold queries into the same grouped-head
+    einsum with identical reduction order."""
+    rng = np.random.default_rng(6)
+    k_pool, v_pool, k_scale, v_scale, tables, lens, _, _ = _make_pools(rng, quant)
+    q = rng.standard_normal((B, HKV * REP, D)).astype(np.float32)
+    kw = dict(
+        k_scale=None if k_scale is None else jnp.asarray(k_scale),
+        v_scale=None if v_scale is None else jnp.asarray(v_scale),
+    )
+    dec = paged_attention_xla(
+        jnp.asarray(q), k_pool, v_pool, jnp.asarray(tables), jnp.asarray(lens), **kw
+    )
+    ver = paged_verify_attention_xla(
+        jnp.asarray(q)[:, None], k_pool, v_pool,
+        jnp.asarray(tables), jnp.asarray(lens - 1), **kw
+    )
+    np.testing.assert_array_equal(np.asarray(dec), np.asarray(ver)[:, 0])
+
+
+def test_verify_dispatch_matches_and_rejects_unknown():
+    rng = np.random.default_rng(7)
+    k_pool, v_pool, _, _, tables, _, _, _ = _make_pools(rng, quant=False)
+    lens = jnp.asarray(np.array([8, 4, 1], np.int32))
+    q = jnp.asarray(rng.standard_normal((B, 3, HKV * REP, D)).astype(np.float32))
+    a = paged_verify_attention(q, k_pool, v_pool, jnp.asarray(tables), lens, impl="auto")
+    x = paged_verify_attention(q, k_pool, v_pool, jnp.asarray(tables), lens, impl="xla")
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(x))  # auto == xla off-TPU
+    with pytest.raises(ValueError):
+        paged_verify_attention(q, k_pool, v_pool, jnp.asarray(tables), lens, impl="mosaic")
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8kv"])
+def test_write_paged_kv_multi_equals_sequential_single_writes(quant):
+    """Q-token scatter == Q sequential single-token writes, bit-for-bit —
+    including the per-row quantization (rows quantize independently in both
+    paths)."""
+    Q = 3
+    layout = {
+        "k": jnp.zeros((NB, BS, HKV, D), jnp.float32),
+        "v": jnp.zeros((NB, BS, HKV, D), jnp.float32),
+    }
+    if quant:
+        layout = {
+            "k": jnp.zeros((NB, BS, HKV, D), jnp.int8),
+            "v": jnp.zeros((NB, BS, HKV, D), jnp.int8),
+            "k_scale": jnp.zeros((NB, BS, HKV), jnp.float32),
+            "v_scale": jnp.zeros((NB, BS, HKV), jnp.float32),
+        }
+    tables = jnp.asarray(np.array([[1, 2, 3, 0], [4, 5, 0, 0], [6, 9, 0, 0]], np.int32))
+    lens = np.array([3, 0, 6], np.int32)  # slot 0 straddles a block boundary
+    rng = np.random.default_rng(8)
+    k_new = jnp.asarray(rng.standard_normal((B, Q, HKV, D)).astype(np.float32))
+    v_new = jnp.asarray(rng.standard_normal((B, Q, HKV, D)).astype(np.float32))
+
+    multi = write_paged_kv_multi(
+        {**layout, "block_tables": tables, "context_lens": jnp.asarray(lens)},
+        k_new, v_new,
+    )
+    seq = {**layout, "block_tables": tables, "context_lens": jnp.asarray(lens)}
+    for j in range(Q):
+        seq = write_paged_kv(seq, k_new[:, j], v_new[:, j])
+        seq["context_lens"] = seq["context_lens"] + 1
+    for key in layout:
+        np.testing.assert_array_equal(np.asarray(multi[key]), np.asarray(seq[key]))
+
+
+def test_write_paged_kv_multi_drops_positions_past_the_table():
+    """Positions >= max_blocks*block_size must be dropped outright (not wrap,
+    not corrupt the null block beyond what padding already does)."""
+    layout = {
+        "k": jnp.zeros((NB, BS, HKV, D), jnp.float32),
+        "v": jnp.zeros((NB, BS, HKV, D), jnp.float32),
+    }
+    tables = jnp.asarray(np.array([[1, 0, 0, 0]] * B, np.int32))
+    lens = jnp.asarray(np.array([MB * BS - 1, MB * BS - 1, MB * BS - 1], np.int32))
+    k_new = jnp.ones((B, 2, HKV, D), jnp.float32)  # position 0 in-range, 1 past
+    out = write_paged_kv_multi(
+        {**layout, "block_tables": tables, "context_lens": lens}, k_new, k_new
+    )
+    k = np.asarray(out["k"])
+    assert k.sum() > 0  # the in-range position landed...
+    written = np.argwhere(np.abs(k).sum(axis=(2, 3)) > 0)
+    assert {tuple(w) for w in written} <= {(0, BS - 1)}  # ...only at table reach
 
 
 def test_paged_branch_rejects_multi_token_steps():

@@ -481,6 +481,17 @@ class ServingConfig:
         kernel on single-device TPU, XLA gather elsewhere), "pallas", "xla".
     :param prefix_caching: ref-counted sharing of full prompt-prefix blocks
         (flushed automatically whenever the parameter snapshot changes).
+    :param spec_k: speculative decoding — draft tokens verified per decode
+        round (0 = off). Drafts come from host-side prompt-lookup n-grams;
+        one fixed-shape verify pass scores all K+1 positions, so each round
+        delivers 1..K+1 tokens per slot at roughly the KV-bandwidth cost of
+        one. Greedy output is bit-identical to non-speculative decode.
+    :param spec_ngram: max n-gram order for the prompt-lookup draft model
+        (longest-suffix match against the slot's own context).
+    :param prefill_chunk: chunked prefill — split admission prefills into
+        chunks of this many tokens, interleaved one chunk per decode round so
+        long prompts stop stalling in-flight decode (0 = whole-prompt
+        prefill). End state per sequence is identical to unchunked prefill.
     """
 
     enabled: bool = False
@@ -490,6 +501,9 @@ class ServingConfig:
     kv_cache_quant: Optional[bool] = None
     attention_impl: str = "auto"
     prefix_caching: bool = True
+    spec_k: int = 0
+    spec_ngram: int = 3
+    prefill_chunk: int = 0
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):

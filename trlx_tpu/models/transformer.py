@@ -426,34 +426,46 @@ class Attention(nn.Module):
             k = apply_rotary(k, cos, sin, c.rope_style)
 
         if cache is not None and "block_tables" in cache:
-            # Paged decode (serving engine): single-token step against the
-            # block-pool cache. The new row lands at position context_lens
-            # (its block is always exclusively owned — the allocator never
-            # leaves a live write frontier inside a shared prefix block), then
-            # attention runs over context_lens+1 tokens gathered through the
-            # block table. Causality is structural — only written slots are
-            # valid — so no mask_bias is consumed; alibi (a position-dependent
-            # score bias) and prefix tuning (scale-less prepended rows) don't
-            # fit that contract and the serving engine refuses such configs.
-            if T != 1:
-                raise ValueError("paged cache supports single-token decode steps only")
+            # Paged step (serving engine) against the block-pool cache. T == 1
+            # is the steady-state decode: the new row lands at position
+            # context_lens (its block is always exclusively owned — the
+            # allocator never leaves a live write frontier inside a shared
+            # prefix block), then attention runs over context_lens+1 tokens
+            # gathered through the block table. T > 1 is the speculative-
+            # verify / chunked-prefill append: token j lands at context_lens+j
+            # and query j attends causally over context_lens+j+1 tokens.
+            # Causality is structural — only written slots are valid — so no
+            # mask_bias is consumed; alibi (a position-dependent score bias)
+            # and prefix tuning (scale-less prepended rows) don't fit that
+            # contract and the serving engine refuses such configs.
             if c.pos_embedding == "alibi" or c.peft_type == "prefix":
                 raise ValueError(
                     "paged decode does not support alibi or prefix tuning"
                 )
             from trlx_tpu.ops.paged_attention import (
-                paged_decode_attention, write_paged_kv,
+                paged_decode_attention, paged_verify_attention,
+                write_paged_kv, write_paged_kv_multi,
             )
 
-            new_cache = write_paged_kv(cache, k[:, 0], v[:, 0])
-            out = paged_decode_attention(
-                q[:, 0], new_cache["k"], new_cache["v"],
-                cache["block_tables"], cache["context_lens"] + 1,
-                k_scale=new_cache.get("k_scale"), v_scale=new_cache.get("v_scale"),
-                scale=1.0 / math.sqrt(c.dim_per_head),
-                impl=c.paged_attention_impl,
-            )
-            out = out.reshape(B, 1, c.num_heads * c.dim_per_head).astype(c.compute_dtype)
+            if T == 1:
+                new_cache = write_paged_kv(cache, k[:, 0], v[:, 0])
+                out = paged_decode_attention(
+                    q[:, 0], new_cache["k"], new_cache["v"],
+                    cache["block_tables"], cache["context_lens"] + 1,
+                    k_scale=new_cache.get("k_scale"), v_scale=new_cache.get("v_scale"),
+                    scale=1.0 / math.sqrt(c.dim_per_head),
+                    impl=c.paged_attention_impl,
+                )
+            else:
+                new_cache = write_paged_kv_multi(cache, k, v)
+                out = paged_verify_attention(
+                    q, new_cache["k"], new_cache["v"],
+                    cache["block_tables"], cache["context_lens"],
+                    k_scale=new_cache.get("k_scale"), v_scale=new_cache.get("v_scale"),
+                    scale=1.0 / math.sqrt(c.dim_per_head),
+                    impl=c.paged_attention_impl,
+                )
+            out = out.reshape(B, T, c.num_heads * c.dim_per_head).astype(c.compute_dtype)
             out = dense(c.hidden_size, "o_proj", c.attn_bias, res_std)(out)
             return out, new_cache
 
@@ -1074,19 +1086,23 @@ class TransformerLM(nn.Module):
         from trlx_tpu.ops.paged_attention import paged_pool_layout
 
         c = self.config
-        if c.stacked:
-            raise NotImplementedError(
-                "paged decode supports the per-layer list layout only "
-                "(scan_layers / pipeline_stages > 1 are unsupported)"
-            )
         layout = paged_pool_layout(
             num_blocks, block_size, c.kv_heads, c.dim_per_head,
             dtype or c.compute_dtype, c.kv_cache_quant,
         )
-        out = {
-            key: [jnp.zeros(shp, dt) for _ in range(c.num_layers)]
-            for key, (shp, dt) in layout.items()
-        }
+        if c.stacked:
+            # nn.scan layout: one stacked [L, ...] pool per k/v leaf, walked by
+            # paged_verify's layer scan (paged_decode keeps the per-layer list
+            # restriction — see its docstring)
+            out = {
+                key: jnp.zeros((c.num_layers,) + shp, dt)
+                for key, (shp, dt) in layout.items()
+            }
+        else:
+            out = {
+                key: [jnp.zeros(shp, dt) for _ in range(c.num_layers)]
+                for key, (shp, dt) in layout.items()
+            }
         out["block_tables"] = jnp.zeros((batch_size, max_blocks_per_seq), jnp.int32)
         out["context_lens"] = jnp.zeros((batch_size,), jnp.int32)
         return out
@@ -1105,6 +1121,11 @@ class TransformerLM(nn.Module):
         if c.peft_type in ("prompt", "prefix"):
             raise NotImplementedError("paged decode does not support peft prompt/prefix")
         B, T = input_ids.shape
+        if T != 1:
+            raise ValueError(
+                "paged_decode is a single-token step; use paged_verify for "
+                "multi-token appends"
+            )
         lens = cache["context_lens"]
         positions = lens[:, None].astype(jnp.int32)  # incoming token's position
         x = self.embed(input_ids, positions)
@@ -1122,4 +1143,50 @@ class TransformerLM(nn.Module):
         }
         new_cache["block_tables"] = cache["block_tables"]
         new_cache["context_lens"] = lens + 1
+        return logits, hidden, new_cache
+
+    def paged_verify(self, input_ids: jnp.ndarray, cache: KVCache):
+        """Multi-token paged step (speculative verify / chunked prefill):
+        ``input_ids`` [B, Q]; token j is written through the block table at
+        position ``context_lens + j`` and attends causally over every earlier
+        position plus itself. Returns (logits [B, Q, V], hidden [B, Q, Hid],
+        new cache with ``context_lens`` UNCHANGED) — the caller decides how
+        far the frontier actually advances (speculative accept count, chunk
+        length); KV rows written past the accepted frontier stay invisible to
+        the attention mask and are rewritten before they can ever become
+        valid, which is what makes rollback free. Supports both the per-layer
+        list layout and the stacked ``scan_layers`` layout (pools ``[L, ...]``,
+        walked by the layer scan with the table/lens broadcast across L)."""
+        c = self.config
+        if c.peft_type in ("prompt", "prefix"):
+            raise NotImplementedError("paged verify does not support peft prompt/prefix")
+        B, Q = input_ids.shape
+        lens = cache["context_lens"]
+        positions = lens[:, None].astype(jnp.int32) + jnp.arange(Q, dtype=jnp.int32)[None, :]
+        x = self.embed(input_ids, positions)
+        pool_keys = [k for k in cache if k not in ("block_tables", "context_lens")]
+        if c.stacked:
+            scan_cache = {key: cache[key] for key in pool_keys}
+            scan_cache["block_tables"] = jnp.broadcast_to(
+                cache["block_tables"], (c.num_layers,) + cache["block_tables"].shape
+            )
+            scan_cache["context_lens"] = jnp.broadcast_to(
+                lens, (c.num_layers,) + lens.shape
+            )
+            x, ys = self.layers_scan(x, None, positions, scan_cache, None)
+            new_cache = {key: ys[key] for key in pool_keys}
+        else:
+            new_layer_caches = []
+            for i, layer in enumerate(self.layers):
+                layer_cache = {key: cache[key][i] for key in pool_keys}
+                layer_cache["block_tables"] = cache["block_tables"]
+                layer_cache["context_lens"] = lens
+                x, new_lc = layer(x, None, positions, layer_cache, None)
+                new_layer_caches.append(new_lc)
+            new_cache = {
+                key: [lc[key] for lc in new_layer_caches] for key in pool_keys
+            }
+        new_cache["block_tables"] = cache["block_tables"]
+        new_cache["context_lens"] = lens
+        logits, hidden = self._final(x)
         return logits, hidden, new_cache

@@ -109,6 +109,77 @@ def paged_attention_xla(
     return out.reshape(B, H, D).astype(q.dtype)
 
 
+def paged_verify_attention_xla(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    context_lens: jnp.ndarray,
+    *,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Speculative-verify / chunked-prefill widening of the reference path.
+
+    q ``[B, Q, H, D]`` — Q tokens appended per slot in one step, token ``j``
+    sitting at position ``context_lens + j`` (``context_lens`` here = tokens
+    present BEFORE this step's append, unlike the decode entry which gets the
+    post-write count). Query ``j`` attends causally: positions
+    ``< context_lens + j + 1``. Returns ``[B, Q, H, D]``.
+
+    Q folds into the grouped-head row axis so the contraction is the same
+    ``bkrd,bskd->bkrs`` einsum as the single-token path — masked scores sit at
+    :data:`NEG_INF`, whose softmax probability underflows to exact 0, so
+    stale/garbage KV past a slot's frontier contributes exactly nothing and
+    ``Q == 1`` with ``context_lens = lens`` reproduces the decode step's
+    output bit-for-bit.
+    """
+    B, Q, H, D = q.shape
+    NB, BS, Hkv, _ = k_pool.shape
+    MB = block_tables.shape[1]
+    S = MB * BS
+    rep = H // Hkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+
+    kh = jnp.take(k_pool, block_tables, axis=0).reshape(B, S, Hkv, D)
+    vh = jnp.take(v_pool, block_tables, axis=0).reshape(B, S, Hkv, D)
+    # [B, Q, H, D] -> [B, Hkv, Q*rep, D]; row r <-> (q_idx = r // rep, rep = r % rep)
+    qg = (
+        q.reshape(B, Q, Hkv, rep, D)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(B, Hkv, Q * rep, D)
+    )
+
+    scores = jnp.einsum(
+        "bkrd,bskd->bkrs", qg, kh, preferred_element_type=jnp.float32
+    ) * scale
+    if k_scale is not None:
+        ks = jnp.take(k_scale, block_tables, axis=0).reshape(B, S, Hkv)
+        scores = scores * ks.transpose(0, 2, 1)[:, :, None, :]
+    q_idx = jnp.arange(Q * rep, dtype=jnp.int32) // rep  # [Q*rep]
+    valid = (
+        jnp.arange(S, dtype=jnp.int32)[None, None, :]
+        < context_lens[:, None, None] + q_idx[None, :, None] + 1
+    )  # [B, Q*rep, S]
+    scores = jnp.where(valid[:, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if v_scale is not None:
+        vs = jnp.take(v_scale, block_tables, axis=0).reshape(B, S, Hkv)
+        probs = probs * vs.transpose(0, 2, 1)[:, :, None, :]
+    out = jnp.einsum(
+        "bkrs,bskd->bkrd", probs, vh.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    out = (
+        out.reshape(B, Hkv, Q, rep, D)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(B, Q, H, D)
+    )
+    return out.astype(q.dtype)
+
+
 def _paged_kernel(
     tables_ref,  # scalar prefetch: [B, MB] int32
     lens_ref,  # scalar prefetch: [B] int32
@@ -167,6 +238,74 @@ def _paged_kernel(
     def _finalize():
         l = l_scratch[...]
         safe_l = jnp.where(l == 0.0, 1.0, l)  # lens >= 1, but never NaN anyway
+        o_ref[0, 0, ...] = (acc_scratch[...] / safe_l).astype(o_ref.dtype)
+
+
+def _paged_verify_kernel(
+    tables_ref,  # scalar prefetch: [B, MB] int32
+    lens_ref,  # scalar prefetch: [B] int32 (tokens present BEFORE the append)
+    q_ref,  # [1, 1, Q*rep, D]
+    k_ref,  # [1, BS, 1, D]
+    v_ref,
+    ks_ref,  # [1, BS, 1] f32 or None (bound via partial when quantized)
+    vs_ref,
+    o_ref,  # [1, 1, Q*rep, D]
+    m_scratch,  # [Q*rep, 1] f32
+    l_scratch,  # [Q*rep, 1] f32
+    acc_scratch,  # [Q*rep, D] f32
+    *,
+    block_size: int,
+    num_blocks_per_seq: int,
+    scale: float,
+    rep: int,
+):
+    """Verify variant of :func:`_paged_kernel`: the Q query positions fold
+    into the row axis (row r is query ``r // rep``, query-head ``r % rep``) so
+    the per-block flash update is unchanged — only the mask limit becomes
+    per-row: query j sees tokens ``< lens + j + 1``. Kept separate from the
+    decode kernel so the ``spec_k == 0`` hot path stays byte-identical.
+    """
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scratch[...] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[...] = jnp.zeros_like(l_scratch)
+        acc_scratch[...] = jnp.zeros_like(acc_scratch)
+
+    q = q_ref[0, 0].astype(jnp.float32)  # [Q*rep, D]
+    k = k_ref[0, :, 0, :].astype(jnp.float32)  # [BS, D]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [Q*rep, BS]
+    if ks_ref is not None:
+        s = s * ks_ref[0, :, 0][None, :]
+
+    rows = q.shape[0]
+    token_idx = j * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, (rows, block_size), 1
+    )
+    q_idx = jax.lax.broadcasted_iota(jnp.int32, (rows, block_size), 0) // rep
+    s = jnp.where(token_idx < lens_ref[b] + q_idx + 1, s, NEG_INF)
+
+    m_prev = m_scratch[...]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.where(m_new > NEG_INF / 2, jnp.exp(s - m_new), 0.0)  # [Q*rep, BS]
+    alpha = jnp.exp(m_prev - m_new)
+    l_scratch[...] = alpha * l_scratch[...] + jnp.sum(p, axis=1, keepdims=True)
+    if vs_ref is not None:
+        p = p * vs_ref[0, :, 0][None, :]
+    v = v_ref[0, :, 0, :].astype(jnp.float32)  # [BS, D]
+    acc_scratch[...] = acc_scratch[...] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_scratch[...] = m_new
+
+    @pl.when(j == num_blocks_per_seq - 1)
+    def _finalize():
+        l = l_scratch[...]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0, ...] = (acc_scratch[...] / safe_l).astype(o_ref.dtype)
 
 
@@ -244,6 +383,77 @@ def paged_attention_pallas(
     return out.reshape(B, H, D)
 
 
+def paged_verify_attention_pallas(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    context_lens: jnp.ndarray,
+    *,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused verify kernel: same ``(B, Hkv, max_blocks)`` grid and scalar-
+    prefetched block walk as :func:`paged_attention_pallas`, with the Q query
+    positions folded into the row axis of each grid cell (``[Q*rep, D]``
+    tiles). ``context_lens`` = tokens present BEFORE the append.
+    """
+    B, Q, H, D = q.shape
+    NB, BS, Hkv, _ = k_pool.shape
+    MB = block_tables.shape[1]
+    rep = H // Hkv
+    rows = Q * rep
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    quant = k_scale is not None
+
+    qg = (
+        q.reshape(B, Q, Hkv, rep, D)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(B, Hkv, rows, D)
+    )
+    kernel = functools.partial(
+        _paged_verify_kernel,
+        block_size=BS, num_blocks_per_seq=MB, scale=scale, rep=rep,
+    )
+    if not quant:
+        kernel = _drop_scale_refs(kernel)
+
+    q_spec = pl.BlockSpec((1, 1, rows, D), lambda b, h, j, t, n: (b, h, 0, 0))
+    kv_spec = pl.BlockSpec((1, BS, 1, D), lambda b, h, j, t, n: (t[b, j], 0, h, 0))
+    in_specs = [q_spec, kv_spec, kv_spec]
+    inputs = [qg, k_pool, v_pool]
+    if quant:
+        sc_spec = pl.BlockSpec((1, BS, 1), lambda b, h, j, t, n: (t[b, j], 0, h))
+        in_specs += [sc_spec, sc_spec]
+        inputs += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv, MB),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, rows, D), lambda b, h, j, t, n: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, rows, D), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32), *inputs)
+    return (
+        out.reshape(B, Hkv, Q, rep, D)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(B, Q, H, D)
+    )
+
+
 def paged_decode_attention(
     q: jnp.ndarray,
     k_pool: jnp.ndarray,
@@ -274,6 +484,38 @@ def paged_decode_attention(
         )
     if impl == "xla":
         return paged_attention_xla(
+            q, k_pool, v_pool, block_tables, context_lens,
+            k_scale=k_scale, v_scale=v_scale, scale=scale,
+        )
+    raise ValueError(f"unknown paged attention impl {impl!r}")
+
+
+def paged_verify_attention(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    context_lens: jnp.ndarray,
+    *,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """Multi-token dispatch, same ``impl`` policy as
+    :func:`paged_decode_attention`. q is ``[B, Q, H, D]``; ``context_lens``
+    counts tokens present BEFORE the Q-token append.
+    """
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" and jax.device_count() == 1 else "xla"
+    if impl == "pallas":
+        return paged_verify_attention_pallas(
+            q, k_pool, v_pool, block_tables, context_lens,
+            k_scale=k_scale, v_scale=v_scale, scale=scale,
+            interpret=jax.default_backend() == "cpu",
+        )
+    if impl == "xla":
+        return paged_verify_attention_xla(
             q, k_pool, v_pool, block_tables, context_lens,
             k_scale=k_scale, v_scale=v_scale, scale=scale,
         )
@@ -316,6 +558,58 @@ def write_paged_kv(
         out["v"] = scatter(cache["v"], vq)
         out["k_scale"] = scatter(cache["k_scale"], ks[..., 0])
         out["v_scale"] = scatter(cache["v_scale"], vs[..., 0])
+    else:
+        out["k"] = scatter(cache["k"], k_new)
+        out["v"] = scatter(cache["v"], v_new)
+    return out
+
+
+def write_paged_kv_multi(
+    cache: dict, k_new: jnp.ndarray, v_new: jnp.ndarray
+) -> dict:
+    """Write Q tokens' K/V per slot: ``k_new``/``v_new`` ``[B, Q, Hkv, D]``,
+    token ``j`` landing at position ``context_lens + j`` through the slot's
+    block table (lens = tokens already present, as in :func:`write_paged_kv`).
+
+    Positions past the table's reach (``>= max_blocks * block_size``) are
+    dropped outright and positions whose table entry is the padding 0 land in
+    the reserved null block — the engine only ever *validates* positions it
+    reserved real blocks for, and any position is rewritten before the
+    attention mask can expose it, so overflow writes are harmless garbage.
+    Quantization matches the single-token path row-for-row
+    (:func:`quantize_kv_rows` per ``[Hkv, D]`` row), which is what keeps the
+    speculative path bit-identical to non-speculative greedy decode.
+    """
+    from trlx_tpu.models.transformer import quantize_kv_rows
+
+    k_pool = cache["k"]
+    NB, BS, Hkv, D = k_pool.shape
+    B, Q = k_new.shape[:2]
+    lens = cache["context_lens"]
+    bt = cache["block_tables"]
+    MB = bt.shape[1]
+    pos = lens[:, None] + jnp.arange(Q, dtype=lens.dtype)[None, :]  # [B, Q]
+    pos_c = jnp.clip(pos, 0, MB * BS - 1)
+    blk = jnp.take_along_axis(bt, pos_c // BS, axis=1)  # [B, Q]
+    # out-of-table positions get an out-of-range flat index; mode="drop" below
+    flat = jnp.where(pos < MB * BS, blk * BS + pos_c % BS, NB * BS).reshape(-1)
+
+    def scatter(pool, rows):
+        vals = rows.reshape(B * Q, *rows.shape[2:]).astype(pool.dtype)
+        return (
+            pool.reshape(NB * BS, *pool.shape[2:])
+            .at[flat].set(vals, mode="drop")
+            .reshape(pool.shape)
+        )
+
+    out = dict(cache)
+    if "k_scale" in cache:
+        kq, ks = quantize_kv_rows(k_new.reshape(B * Q, Hkv, D))
+        vq, vs = quantize_kv_rows(v_new.reshape(B * Q, Hkv, D))
+        out["k"] = scatter(cache["k"], kq.reshape(B, Q, Hkv, D))
+        out["v"] = scatter(cache["v"], vq.reshape(B, Q, Hkv, D))
+        out["k_scale"] = scatter(cache["k_scale"], ks[..., 0].reshape(B, Q, Hkv))
+        out["v_scale"] = scatter(cache["v_scale"], vs[..., 0].reshape(B, Q, Hkv))
     else:
         out["k"] = scatter(cache["k"], k_new)
         out["v"] = scatter(cache["v"], v_new)
@@ -422,5 +716,109 @@ def build_paged_decode_step(spec: str, mesh) -> EntryArtifacts:
         # (preferred_element_type, flash-kernel algebra): 2 dots/layer
         f32_allow=frozenset({"dot_general:4"}),
         meta=dict(batch=B, num_blocks=NB, block_size=BS,
+                  hidden_size=dims["hidden"], num_layers=dims["layers"]),
+    )
+
+
+@register_entrypoint("spec_verify_step", specs=("small", "xl"))
+def build_spec_verify_step(spec: str, mesh) -> EntryArtifacts:
+    """The speculative-verify round as graftcheck-ir audits it: ``K + 1``
+    tokens per slot (pending token + K n-gram drafts) through
+    ``TransformerLM.paged_verify`` — multi-position paged-KV write + the
+    widened verify attention — then per-position sampling and the on-device
+    accept count, exactly the jitted ``_verify_step`` the serving engine runs
+    when ``serving.spec_k > 0``.
+
+    ``small`` mirrors ``paged_decode_step``'s dims (int8-KV, per-layer pool
+    lists) and is what CI compiles and gates against the budget. ``xl`` is
+    the GPT-2-XL blueprint — scanned layers over *stacked* ``[L, ...]``
+    pools — and exists to be lowered deviceless so paged/speculative decode
+    evidence reaches past gpt2-small (ROADMAP big-model item).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from trlx_tpu.models.presets import PRESETS
+    from trlx_tpu.models.transformer import TransformerLM
+    from trlx_tpu.ops.sampling import (
+        AUDIT_GEN_KWARGS, count_accepted_drafts, sample_token,
+    )
+    from trlx_tpu.parallel.mesh import BATCH_AXES
+    from trlx_tpu.parallel.sharding import make_param_shardings
+
+    dims = {
+        "small": dict(hidden=64, layers=2, heads=4, vocab=256, B=8,
+                      num_blocks=24, block_size=8, max_blocks=4, spec_k=4,
+                      scan_layers=False),
+        # GPT-2-XL shapes (~1.5B params), scanned layers + stacked pools
+        "xl": dict(hidden=1600, layers=48, heads=25, vocab=50257, B=8,
+                   num_blocks=64, block_size=16, max_blocks=16, spec_k=4,
+                   scan_layers=True),
+    }[spec]
+    model_config = PRESETS["gpt2"].replace(
+        vocab_size=dims["vocab"], hidden_size=dims["hidden"],
+        num_layers=dims["layers"], num_heads=dims["heads"],
+        intermediate_size=4 * dims["hidden"], max_position_embeddings=1024,
+        param_dtype=jnp.float32, compute_dtype=jnp.bfloat16,
+        kv_cache_quant=True, scan_layers=dims["scan_layers"],
+    )
+    trunk = TransformerLM(model_config)
+
+    params_shape = jax.eval_shape(
+        lambda: trunk.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 2), jnp.int32), jnp.ones((1, 2), jnp.int32)
+        )
+    )["params"]
+    abs_params = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+        params_shape, make_param_shardings(params_shape, mesh),
+    )
+
+    B, K = dims["B"], dims["spec_k"]
+    NB, BS, MB = dims["num_blocks"], dims["block_size"], dims["max_blocks"]
+    kvh, dph = model_config.kv_heads, model_config.dim_per_head
+    repl = NamedSharding(mesh, PartitionSpec())
+    bsh = NamedSharding(mesh, PartitionSpec(BATCH_AXES))
+    bsh2 = NamedSharding(mesh, PartitionSpec(BATCH_AXES, None))
+    layout = paged_pool_layout(NB, BS, kvh, dph, model_config.compute_dtype, True)
+    if dims["scan_layers"]:
+        abs_cache = {
+            key: jax.ShapeDtypeStruct((dims["layers"],) + shp, dt, sharding=repl)
+            for key, (shp, dt) in layout.items()
+        }
+    else:
+        abs_cache = {
+            key: [jax.ShapeDtypeStruct(shp, dt, sharding=repl)
+                  for _ in range(dims["layers"])]
+            for key, (shp, dt) in layout.items()
+        }
+    abs_cache["block_tables"] = jax.ShapeDtypeStruct((B, MB), jnp.int32, sharding=bsh2)
+    abs_cache["context_lens"] = jax.ShapeDtypeStruct((B,), jnp.int32, sharding=bsh)
+    abs_tok = jax.ShapeDtypeStruct((B, K + 1), jnp.int32, sharding=bsh2)
+    abs_rng = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+
+    def verify_fn(params, tok, cache, rng):
+        lens0 = cache["context_lens"]
+        logits, _, new_cache = trunk.apply(
+            {"params": params}, tok, cache, method=trunk.paged_verify
+        )
+        y = sample_token(rng, logits, **AUDIT_GEN_KWARGS)  # [B, K+1]
+        accepted = count_accepted_drafts(y, tok)
+        new_cache["context_lens"] = lens0 + accepted + 1
+        return y, accepted, new_cache
+
+    cache_out_shardings = jax.tree.map(lambda _: repl, abs_cache)
+    cache_out_shardings["block_tables"] = bsh2
+    cache_out_shardings["context_lens"] = bsh
+
+    return EntryArtifacts(
+        fn=verify_fn,
+        args=(abs_params, abs_tok, abs_cache, abs_rng),
+        donate_argnums=(2,),
+        out_shardings=(bsh2, bsh, cache_out_shardings),
+        compute_dtype="bfloat16",
+        # verify attention accumulates scores and probs@V in f32 like the
+        # decode step: 2 dots/layer
+        f32_allow=frozenset({"dot_general:4"}),
+        meta=dict(batch=B, spec_k=K, num_blocks=NB, block_size=BS,
                   hidden_size=dims["hidden"], num_layers=dims["layers"]),
     )

@@ -171,6 +171,28 @@ def sample_token(
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def count_accepted_drafts(
+    sampled: jnp.ndarray, proposed: jnp.ndarray
+) -> jnp.ndarray:
+    """Leading-match accept count for speculative verify.
+
+    ``proposed`` ``[B, K+1]`` is what the verify pass scored: the pending
+    token followed by K draft tokens. ``sampled`` ``[B, K+1]`` is the
+    per-position model output (position j is the model's choice *after*
+    ``proposed[:, :j+1]``). Draft j+1 is accepted iff it equals what the
+    model would have emitted at position j AND every earlier draft was
+    accepted — the count is the length of the leading run of
+    ``proposed[:, 1:] == sampled[:, :K]``, in ``[0, K]``. Greedy decode then
+    emits ``sampled[:, :accepted+1]``, which is by construction the exact
+    token sequence non-speculative decode produces one step at a time.
+    """
+    K = proposed.shape[1] - 1
+    if K == 0:
+        return jnp.zeros((proposed.shape[0],), jnp.int32)
+    match = (proposed[:, 1:] == sampled[:, :K]).astype(jnp.int32)
+    return jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+
+
 #: The sampling configuration graftcheck-ir's decode audit locks down: the
 #: full temperature -> top-k -> top-p -> categorical pipeline, with the exact
 #: top-k implementation so the compiled HLO is identical across backends

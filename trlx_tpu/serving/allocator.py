@@ -161,7 +161,18 @@ class PagedBlockAllocator:
         blocks only — the write frontier never enters a shared block, and a
         decode-time block is never prefix-registered. Returns False without
         allocating anything when the pool cannot cover the growth (the
-        engine's KV-pressure preemption path takes over)."""
+        engine's KV-pressure preemption path takes over).
+
+        Speculative lookahead: with ``serving.spec_k > 0`` the engine calls
+        this with ``total_len = lens + spec_k + 1`` (clamped to the
+        sequence's hard cap) BEFORE the verify round, so all K+1 in-flight
+        draft positions have real blocks. The clamp means positions past the
+        cap are intentionally uncovered — the verify write drops them
+        (``write_paged_kv_multi``), and the request finishes at the cap
+        before any such position could become valid. Worst-case admission
+        (no policy) needs no per-round call at all: the up-front
+        ``prompt + max_new`` reservation already covers every position the
+        accept rule can validate."""
         need = self.blocks_needed(total_len) - len(seq.blocks)
         if need <= 0:
             return True

@@ -20,6 +20,30 @@ block and their outputs are discarded. The scheduler refills a slot the step
 after its sequence finishes, which is the whole point: delivered tokens/sec
 tracks *live* sequences, not the longest straggler in a padded batch.
 
+Two optional multi-token modes attack the decode bandwidth bound (each round
+reads all params + live KV; emitting one token per slot per read is the
+ceiling BENCH_r05 measured at ~0.44 of bandwidth):
+
+- **speculative decoding** (``spec_k > 0``) — a host-side prompt-lookup
+  n-gram draft proposes up to K tokens per slot; one fixed-shape verify step
+  (``TransformerLM.paged_verify``) scores pending + K drafts at once, samples
+  every position on device, and accepts the longest leading run of drafts
+  that match what the model would have emitted. Greedy output is
+  bit-identical to the non-speculative path (the accept rule only ever keeps
+  tokens the plain decode would have produced; rejected-draft KV sits beyond
+  ``context_lens`` and is rewritten before it can become valid). ``spec_k=0``
+  keeps the original single-token program byte-identical.
+- **chunked prefill** (``prefill_chunk > 0``) — prompts longer than the
+  chunk run their first chunk through the ordinary bucketed prefill and the
+  rest through per-round batch-1 ``paged_verify`` appends interleaved with
+  decode rounds, so a long admission no longer stalls every live slot for a
+  full-prompt forward. Mid-prefill slots are masked out of the decode batch
+  (null table row, len 0) until their last chunk samples the first token.
+
+Sampling runs inside the jitted decode/verify/chunk steps — the only values
+that cross back per round are sampled tokens and accept counts, never
+logits.
+
 Sampling consumes one rng fold per engine event (prefill wave or decode
 step), so sampled streams are reproducible for a fixed seed + submission
 order but do not bit-match ``ops/generation.generate`` (which folds per
@@ -32,6 +56,7 @@ this with a lock — rollout producers call through
 :class:`trlx_tpu.serving.client.GenerationClient`, which serializes).
 """
 
+import os
 import threading
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -42,7 +67,7 @@ import jax
 import jax.numpy as jnp
 
 from trlx_tpu.ops.generation import left_pad_batch, pad_to_bucket
-from trlx_tpu.ops.sampling import sample_token
+from trlx_tpu.ops.sampling import count_accepted_drafts, sample_token
 from trlx_tpu.resilience.chaos import chaos
 from trlx_tpu.serving.allocator import PagedBlockAllocator
 from trlx_tpu.serving.policy import (
@@ -68,13 +93,57 @@ def _pow2_at_least(n: int, cap: int) -> int:
     return min(b, cap)
 
 
+def _ngram_propose(
+    ctx: np.ndarray, k: int, max_order: int, pad_token: int
+) -> np.ndarray:
+    """Prompt-lookup drafting: propose ``k`` tokens by matching the longest
+    suffix n-gram (order ``max_order`` down to 1) earlier in the context and
+    continuing from the LATEST such match (recent repetition predicts the
+    near future better than distant repetition). Pure host numpy — the draft
+    must be cheaper than the verify pass by orders of magnitude or the whole
+    scheme loses. No match (or a match flush against the end) pads with
+    ``pad_token``: a wrong draft costs nothing beyond the verify FLOPs the
+    fixed-shape step was paying anyway.
+    """
+    out = np.full((k,), pad_token, np.int32)
+    L = len(ctx)
+    if L < 2:
+        return out
+    for n in range(min(max_order, L - 1), 0, -1):
+        tail = ctx[L - n:]
+        # windows of length n starting at j cover ctx[j : j+n]; exclude the
+        # suffix itself (j = L - n) so the continuation is a real lookbehind
+        n_cand = L - n
+        m = np.ones((n_cand,), bool)
+        for j in range(n):
+            m &= ctx[j : j + n_cand] == tail[j]
+        hits = np.nonzero(m)[0]
+        if len(hits) == 0:
+            continue
+        start = int(hits[-1]) + n  # continuation of the latest match
+        take = ctx[start : start + k]
+        out[: len(take)] = take
+        return out
+    return out
+
+
 @dataclass
 class ServingStats:
+    # true decode-round emission count: every token handed to the scheduler
+    # by a decode round — 1/slot plain, 1..K+1/slot speculative (prefill's
+    # first sampled token is prefill accounting, as before)
     delivered_tokens: int = 0
     prefill_tokens: int = 0
     decode_steps: int = 0
     prefill_waves: int = 0
     finished_requests: int = 0
+    # sum over decode rounds of live-slot count — the denominator for
+    # accepted-tokens-per-round (= exactly 1.0 when spec is off)
+    decode_slot_rounds: int = 0
+    spec_rounds: int = 0
+    spec_draft_tokens: int = 0
+    spec_accepted_tokens: int = 0
+    chunk_appends: int = 0
 
 
 class ServingEngine:
@@ -94,10 +163,20 @@ class ServingEngine:
         prefix_caching: bool = True,
         seed: int = 0,
         policy: Optional[ServingResiliencePolicy] = None,
+        spec_k: int = 0,
+        spec_ngram: int = 3,
+        prefill_chunk: int = 0,
     ):
         """``trunk`` is a built ``TransformerLM`` (its config decides the KV
         dtype via ``kv_cache_quant`` and the kernel via
-        ``paged_attention_impl``); ``params`` its parameter subtree."""
+        ``paged_attention_impl``); ``params`` its parameter subtree.
+
+        ``spec_k`` > 0 enables speculative decoding (K n-gram draft tokens
+        verified per round; 0 = the original single-token step, byte-
+        identical). ``spec_ngram`` caps the draft-match n-gram order.
+        ``prefill_chunk`` > 0 splits admissions longer than the chunk into
+        per-round ``paged_verify`` appends interleaved with decode (0 =
+        whole-prompt bucketed prefill)."""
         c = trunk.config
         if c.stacked:
             raise NotImplementedError("serving engine: per-layer list layout only")
@@ -119,6 +198,24 @@ class ServingEngine:
         self.pad_token_id = int(pad_token_id)
         self.gen_kwargs = dict(gen_kwargs or {})
         self.min_new_tokens = int(min_new_tokens)
+        self.spec_k = int(spec_k)
+        self.spec_ngram = int(spec_ngram)
+        self.prefill_chunk = int(prefill_chunk)
+        if self.spec_k < 0 or self.spec_ngram < 1 or self.prefill_chunk < 0:
+            raise ValueError(
+                f"spec_k={spec_k} must be >= 0, spec_ngram={spec_ngram} >= 1, "
+                f"prefill_chunk={prefill_chunk} >= 0"
+            )
+        # seeded CI regression hook: "accept_all" forces the verify step to
+        # claim every draft accepted, which must break the greedy spec/non-spec
+        # parity gate (scripts/ci.sh proves the gate bites by requiring the
+        # parity test to FAIL under this env)
+        seed_reg = os.environ.get("TRLX_SPEC_SEED_REGRESSION", "")
+        if seed_reg not in ("", "accept_all"):
+            raise ValueError(
+                f"TRLX_SPEC_SEED_REGRESSION={seed_reg!r}: only 'accept_all' is defined"
+            )
+        self._spec_seed_regression = seed_reg
 
         self.allocator = PagedBlockAllocator(
             self.num_blocks, self.block_size, prefix_caching=prefix_caching
@@ -149,9 +246,14 @@ class ServingEngine:
         self._tables_dirty = True
         # the next input token per slot (sampled last round, not yet written)
         self._pending_tok = np.zeros((self.num_slots,), np.int32)
+        # slots mid chunked-prefill: masked out of the decode batch (their
+        # device table row/len are zeroed) until the final chunk lands
+        self._prefilling = np.zeros((self.num_slots,), bool)
 
         donate = (2,) if jax.default_backend() == "tpu" else ()
         self._decode_step = jax.jit(self._decode_step_impl, donate_argnums=donate)
+        self._verify_step = jax.jit(self._verify_step_impl, donate_argnums=donate)
+        self._chunk_step = jax.jit(self._chunk_step_impl, donate_argnums=donate)
         self._prefill = jax.jit(self._prefill_impl)
         pack_donate = (0,) if jax.default_backend() == "tpu" else ()
         self._pack = jax.jit(self._pack_impl, donate_argnums=pack_donate)
@@ -175,6 +277,66 @@ class ServingEngine:
         )
         rng, next_tok = self._sample(rng, logits[:, -1, :], new_counts)
         return next_tok, new_cache, rng
+
+    def _sample_positions(self, rng, logits, counts):
+        """Per-position sampling for the verify step: ``logits`` [S, Q, V],
+        ``counts`` [S, Q] = each position's generated-token index (drives the
+        min_new_tokens eos mask exactly as :meth:`_sample` does per step —
+        position j of a verify round IS generated token ``len(generated)+j``
+        of the sequential decode it replays)."""
+        rng, sub = jax.random.split(rng)
+        if self.eos_token_id is not None and self.min_new_tokens > 0:
+            eos_col = jnp.arange(logits.shape[-1]) == self.eos_token_id
+            logits = jnp.where(
+                (counts[..., None] < self.min_new_tokens) & eos_col[None, None, :],
+                -1e9, logits,
+            )
+        tok = sample_token(sub, logits, **self.gen_kwargs)
+        return rng, tok
+
+    def _verify_step_impl(self, params, tok, cache, rng, new_counts):
+        """Speculative verify round: ``tok`` [S, K+1] = pending token + K
+        n-gram drafts per slot. One widened paged forward scores every
+        position, per-position sampling and the leading-match accept count
+        stay on device, and ``context_lens`` advances by ``accepted + 1`` —
+        rejected-draft KV past the new frontier stays invisible to the
+        attention mask and is rewritten before it can ever become valid, so
+        rollback is free. Only [S, K+1] tokens + [S] counts cross back to the
+        host (no logits round-trip)."""
+        lens0 = cache["context_lens"]
+        logits, _, new_cache = self.trunk.apply(
+            {"params": params}, tok, cache, method=self.trunk.paged_verify
+        )
+        counts = (
+            new_counts[:, None]
+            + jnp.arange(tok.shape[1], dtype=jnp.int32)[None, :]
+        )
+        rng, y = self._sample_positions(rng, logits, counts)
+        accepted = count_accepted_drafts(y, tok)
+        if self._spec_seed_regression == "accept_all":
+            accepted = jnp.full_like(accepted, tok.shape[1] - 1)
+        new_cache["context_lens"] = lens0 + accepted + 1
+        return y, accepted, new_cache, rng
+
+    def _chunk_step_impl(self, params, ids, cache, rng, last_idx, new_counts):
+        """One chunked-prefill append: ``ids`` [n, C] (pad-filled on the final
+        partial chunk) writes all C positions' KV through the slot's table via
+        ``paged_verify`` and samples a next token from the logit at
+        ``last_idx`` — only the final chunk's sample is consumed (earlier
+        chunks' logits condition on an incomplete prompt). ``context_lens``
+        is not advanced on device; the host mirror owns the prefilled
+        frontier. Pad positions write garbage KV beyond the prompt, which the
+        first decode/verify round overwrites before the mask can expose it."""
+        logits, _, new_cache = self.trunk.apply(
+            {"params": params}, ids, cache, method=self.trunk.paged_verify
+        )
+        last = jnp.take_along_axis(logits, last_idx[:, None, None], axis=1)[:, 0, :]
+        rng, tok = self._sample(rng, last, new_counts)
+        pools = {
+            k: v for k, v in new_cache.items()
+            if k not in ("block_tables", "context_lens")
+        }
+        return tok, pools, rng
 
     def _prefill_impl(self, params, ids, mask, rng, new_counts=None):
         # ``new_counts=None`` (fresh prompts) keeps the compiled graph
@@ -276,6 +438,7 @@ class ServingEngine:
         self._tables[slot] = 0
         self._lens[slot] = 0
         self._pending_tok[slot] = self.pad_token_id
+        self._prefilling[slot] = False
         self._tables_dirty = True
 
     def _admit(self) -> List[Request]:
@@ -296,15 +459,25 @@ class ServingEngine:
         # supervisor's replay case (live requests re-queued onto a new engine)
         chaos.fail_if_armed("serving-prefill", f"{len(placements)} placements")
         # group by bucketed prefill length so one wave compiles per bucket
-        # pair; prefill covers prompt + generated-so-far (re-admissions)
-        by_bucket: Dict[int, List[Tuple[int, Request]]] = {}
+        # pair; prefill covers prompt + generated-so-far (re-admissions).
+        # Chunked mode prefills only the FIRST chunk here (through the same
+        # compiled wave program) and marks the slot mid-prefill; the rest
+        # arrives via _advance_prefill_chunks interleaved with decode rounds.
+        by_bucket: Dict[int, List[Tuple[int, Request, List[int]]]] = {}
         for slot, req in placements:
+            ids_full = req.prefill_ids
+            if 0 < self.prefill_chunk < len(ids_full):
+                self._prefilling[slot] = True
+                req.prefilled = 0
+                first = ids_full[: self.prefill_chunk]
+            else:
+                first = ids_full
             by_bucket.setdefault(
-                pad_to_bucket(len(req.prefill_ids), PREFILL_LEN_BUCKETS), []
-            ).append((slot, req))
+                pad_to_bucket(len(first), PREFILL_LEN_BUCKETS), []
+            ).append((slot, req, first))
         for P_b, group in sorted(by_bucket.items()):
             n_b = _pow2_at_least(len(group), self.num_slots)
-            ids_list = [np.asarray(req.prefill_ids, np.int32) for _, req in group]
+            ids_list = [np.asarray(first, np.int32) for _, _, first in group]
             ids, mask = left_pad_batch(ids_list, self.pad_token_id, P_b)
             if n_b > len(group):  # pad the wave to its batch bucket
                 ids = np.concatenate(
@@ -319,7 +492,7 @@ class ServingEngine:
                 # learned table on some configs; give them token 0 @ pos 0
                 mask[len(group):, -1] = 1
             counts = np.zeros((n_b,), np.int32)
-            for i, (_, req) in enumerate(group):
+            for i, (_, req, _) in enumerate(group):
                 counts[i] = len(req.generated)
             tok, cont, self._rng = self._prefill(
                 self.params,  # graftcheck: noqa[TH001] — under step()'s lock
@@ -328,10 +501,10 @@ class ServingEngine:
             )
             rows = np.zeros((n_b, self.max_blocks_per_seq), np.int32)
             lens = np.zeros((n_b,), np.int32)
-            for i, (slot, req) in enumerate(group):
+            for i, (slot, req, first) in enumerate(group):
                 blocks = req.seq_blocks.blocks
                 rows[i, : len(blocks)] = blocks
-                lens[i] = len(req.prefill_ids)
+                lens[i] = len(first)
             pools = {
                 k: v for k, v in self.cache.items()
                 if k not in ("block_tables", "context_lens")
@@ -341,13 +514,75 @@ class ServingEngine:
             self.cache.update(packed)
             tok_np = np.asarray(jax.device_get(tok))
             self.stats.prefill_waves += 1
-            self.stats.prefill_tokens += int(sum(len(r.prefill_ids) for _, r in group))
-            for i, (slot, req) in enumerate(group):
+            self.stats.prefill_tokens += int(sum(len(first) for _, _, first in group))
+            for i, (slot, req, first) in enumerate(group):
                 self._tables[slot] = rows[i]
-                self._lens[slot] = len(req.prefill_ids)
-                self._pending_tok[slot] = tok_np[i]
+                self._lens[slot] = len(first)
                 self._tables_dirty = True
+                if self._prefilling[slot]:
+                    # prompt incomplete: the wave's sampled token conditioned
+                    # on a truncated prompt and is discarded; the final chunk
+                    # samples the real first token
+                    req.prefilled = len(first)
+                    continue
+                self._pending_tok[slot] = tok_np[i]
                 done = self.scheduler.on_token(slot, int(tok_np[i]))
+                if done is not None:
+                    finished.append(done)
+                    self._free_slot_state(slot)
+        return finished
+
+    def _advance_prefill_chunks(self) -> List[Request]:
+        """Advance every mid-prefill slot by one chunk (batch-1
+        ``paged_verify`` appends into the shared pools through the slot's own
+        table row), interleaved with decode rounds so a long admission stops
+        stalling live slots. The final chunk samples the sequence's first
+        token on device — after it lands, the slot's state is IDENTICAL to a
+        whole-prompt prefill (lens = prompt length, pending = first sampled
+        token), which is what keeps chunked output bit-equal to unchunked."""
+        finished: List[Request] = []
+        if not self._prefilling.any():
+            return finished
+        C = self.prefill_chunk
+        pool_keys = [
+            k for k in self.cache if k not in ("block_tables", "context_lens")
+        ]
+        for slot in np.nonzero(self._prefilling)[0]:
+            slot = int(slot)
+            req = self.scheduler.slots[slot]
+            if req is None:  # freed (cancel/expiry/preempt) mid-prefill
+                self._prefilling[slot] = False
+                continue
+            ids_full = req.prefill_ids
+            start = req.prefilled
+            chunk = ids_full[start : start + C]
+            n_v = len(chunk)
+            ids = np.full((1, C), self.pad_token_id, np.int32)
+            ids[0, :n_v] = chunk
+            row = np.zeros((1, self.max_blocks_per_seq), np.int32)
+            blocks = req.seq_blocks.blocks
+            row[0, : len(blocks)] = blocks
+            cache1 = {key: self.cache[key] for key in pool_keys}
+            cache1["block_tables"] = jnp.asarray(row)
+            cache1["context_lens"] = jnp.asarray(np.array([start], np.int32))
+            tok, pools, self._rng = self._chunk_step(
+                self.params,  # graftcheck: noqa[TH001] — under step()'s lock
+                jnp.asarray(ids), cache1, self._rng,
+                jnp.asarray(np.array([n_v - 1], np.int32)),
+                jnp.asarray(np.array([len(req.generated)], np.int32)),
+            )
+            self.cache.update(pools)
+            req.prefilled = start + n_v
+            self._lens[slot] = req.prefilled
+            self.stats.prefill_tokens += n_v
+            self.stats.chunk_appends += 1
+            if req.prefilled >= len(ids_full):
+                # prompt complete: unmask the slot into the decode batch
+                self._prefilling[slot] = False
+                self._tables_dirty = True
+                tok_i = int(np.asarray(jax.device_get(tok))[0])
+                self._pending_tok[slot] = tok_i
+                done = self.scheduler.on_token(slot, tok_i)
                 if done is not None:
                     finished.append(done)
                     self._free_slot_state(slot)
@@ -377,7 +612,18 @@ class ServingEngine:
         for slot, req in enumerate(self.scheduler.slots):
             if req is None:
                 continue
-            need_len = int(self._lens[slot]) + 1  # the incoming token's KV
+            if self._prefilling[slot]:
+                # mid chunked-prefill: admission reserved the whole prefill
+                # (+1) up front; no per-round growth until decode starts
+                continue
+            # lookahead covers every KV position this round can write: the
+            # incoming token plus spec_k draft positions, clamped to the hard
+            # sequence cap (positions past it are write-dropped and can never
+            # be validated — the request finishes at the cap first)
+            need_len = min(
+                int(self._lens[slot]) + 1 + self.spec_k,
+                len(req.prompt) + req.max_new_tokens,
+            )
             before = len(req.seq_blocks.blocks)
             ok = (not chaos.should_fail("serving-alloc")) and self.allocator.extend(
                 req.seq_blocks, need_len
@@ -403,47 +649,110 @@ class ServingEngine:
                 self._tables[slot, : len(req.seq_blocks.blocks)] = req.seq_blocks.blocks
                 self._tables_dirty = True
 
+    def _push_mirrors(self) -> None:
+        """Push the host table/len mirrors to the device when stale. While a
+        slot is mid chunked-prefill its true state (partial lens, real table
+        row) must stay OFF the decode inputs — the pushed copy masks it to
+        the null row / len 0 so the full-batch step treats it as idle — and
+        the mirror stays dirty so completion re-pushes the real state."""
+        prefill_active = bool(self._prefilling.any())
+        if not (self._tables_dirty or prefill_active):
+            return
+        # push COPIES of the host mirrors: jnp.asarray may zero-copy an
+        # aligned numpy buffer on CPU, and the mirrors are mutated in
+        # place (``self._lens += ...`` below, slot frees) while the
+        # dispatched step may still be reading the aliased device buffer
+        # — an intermittent corruption under async dispatch
+        tables = np.array(self._tables)
+        lens = np.array(self._lens)
+        if prefill_active:
+            tables[self._prefilling] = 0
+            lens[self._prefilling] = 0
+        self.cache["block_tables"] = jnp.asarray(tables)
+        self.cache["context_lens"] = jnp.asarray(lens)
+        self._tables_dirty = prefill_active
+
     def _decode_round(self) -> List[Request]:
         finished: List[Request] = []
         for slot, req in self.scheduler.expire_live():
             self._free_slot_state(slot)
             finished.append(req)
         self._ensure_decode_capacity()
-        live = [s for s, r in enumerate(self.scheduler.slots) if r is not None]
+        live = [
+            s for s, r in enumerate(self.scheduler.slots)
+            if r is not None and not self._prefilling[s]
+        ]
         if not live:
             return finished
         chaos.fail_if_armed("serving-decode", f"{len(live)} live slots")
-        if self._tables_dirty:
-            # push COPIES of the host mirrors: jnp.asarray may zero-copy an
-            # aligned numpy buffer on CPU, and the mirrors are mutated in
-            # place (``self._lens += 1`` below, slot frees) while the
-            # dispatched step may still be reading the aliased device buffer
-            # — an intermittent corruption under async dispatch
-            self.cache["block_tables"] = jnp.asarray(np.array(self._tables))
-            self.cache["context_lens"] = jnp.asarray(np.array(self._lens))
-            self._tables_dirty = False
+        self._push_mirrors()
         new_counts = np.array(
             [len(r.generated) if r is not None else 0 for r in self.scheduler.slots],
             np.int32,
         )
-        next_tok, self.cache, self._rng = self._decode_step(
-            self.params,  # graftcheck: noqa[TH001] — under step()'s lock
-            jnp.asarray(self._pending_tok), self.cache,
-            self._rng, jnp.asarray(new_counts),
-        )
-        # device lens advanced for every slot; mirror so a no-admission next
-        # step needs no host->device sync
-        self._lens += 1
-        tok_np = np.asarray(jax.device_get(next_tok))
+        if self.spec_k > 0:
+            finished.extend(self._spec_round(live, new_counts))
+        else:
+            next_tok, self.cache, self._rng = self._decode_step(
+                self.params,  # graftcheck: noqa[TH001] — under step()'s lock
+                jnp.asarray(self._pending_tok), self.cache,
+                self._rng, jnp.asarray(new_counts),
+            )
+            # device lens advanced for every slot; mirror so a no-admission
+            # next step needs no host->device sync
+            self._lens += 1
+            tok_np = np.asarray(jax.device_get(next_tok))
+            for slot in live:
+                self._pending_tok[slot] = tok_np[slot]
+                done = self.scheduler.on_token(slot, int(tok_np[slot]))
+                if done is not None:
+                    finished.append(done)
+                    self._free_slot_state(slot)
+            self.stats.delivered_tokens += len(live)
+        self.scheduler.note_step()
+        self.stats.decode_steps += 1
+        self.stats.decode_slot_rounds += len(live)
+        return finished
+
+    def _spec_round(self, live: List[int], new_counts: np.ndarray) -> List[Request]:
+        """One speculative decode round over the full slot batch: host n-gram
+        drafts, one jitted verify step, per-slot accept bookkeeping. Emits
+        ``accepted + 1`` tokens per live slot — every one of them provably
+        what sequential greedy decode would have produced (the accept rule),
+        which is the whole bandwidth play: one weight/KV read, many tokens."""
+        finished: List[Request] = []
+        K = self.spec_k
+        drafts = np.zeros((self.num_slots, K), np.int32)
         for slot in live:
-            self._pending_tok[slot] = tok_np[slot]
-            done = self.scheduler.on_token(slot, int(tok_np[slot]))
+            req = self.scheduler.slots[slot]
+            drafts[slot] = _ngram_propose(
+                np.asarray(req.prefill_ids, np.int32), K,
+                self.spec_ngram, self.pad_token_id,
+            )
+        tok = np.concatenate([self._pending_tok[:, None], drafts], axis=1)
+        y, accepted, self.cache, self._rng = self._verify_step(
+            self.params,  # graftcheck: noqa[TH001] — under step()'s lock
+            jnp.asarray(tok), self.cache, self._rng, jnp.asarray(new_counts),
+        )
+        acc_np = np.asarray(jax.device_get(accepted))
+        y_np = np.asarray(jax.device_get(y))
+        # device advanced EVERY slot's frontier by accepted+1 (idle slots
+        # included, off their null garbage); mirror the same arithmetic so
+        # host and device lens never diverge
+        self._lens += acc_np.astype(np.int32) + 1
+        self.stats.spec_rounds += 1
+        self.stats.spec_draft_tokens += K * len(live)
+        for slot in live:
+            a = int(acc_np[slot])
+            self.stats.spec_accepted_tokens += a
+            self._pending_tok[slot] = y_np[slot, a]
+            done, emitted = self.scheduler.on_tokens(
+                slot, [int(t) for t in y_np[slot, : a + 1]]
+            )
+            self.stats.delivered_tokens += emitted
             if done is not None:
                 finished.append(done)
                 self._free_slot_state(slot)
-        self.scheduler.note_step()
-        self.stats.decode_steps += 1
-        self.stats.delivered_tokens += len(live)
         return finished
 
     def request_abort(self) -> None:
@@ -469,6 +778,7 @@ class ServingEngine:
                 self._abort_evt.clear()
                 raise EngineWedgedError("engine step loop wedged and was aborted")
             finished = self._admit()
+            finished += self._advance_prefill_chunks()
             finished += self._decode_round()
             for req in finished:
                 self.stats.finished_requests += 1
@@ -534,6 +844,19 @@ class ServingEngine:
                 "decode_steps": float(self.stats.decode_steps),
                 "prefill_waves": float(self.stats.prefill_waves),
                 "finished_requests": float(self.stats.finished_requests),
+                # tokens emitted per live slot per decode round: exactly 1.0
+                # with spec off; > 1 measures the speculative multiplier
+                # actually delivered (the bandwidth-bound divisor)
+                "accepted_tok_per_round": (
+                    self.stats.delivered_tokens
+                    / max(1, self.stats.decode_slot_rounds)
+                ),
+                "spec_accept_rate": (
+                    self.stats.spec_accepted_tokens
+                    / max(1, self.stats.spec_draft_tokens)
+                ),
+                "spec_rounds": float(self.stats.spec_rounds),
+                "chunk_appends": float(self.stats.chunk_appends),
             }
         out["mean_slot_occupancy"] = self.scheduler.mean_slot_occupancy
         out["prefix_cache_hit_rate"] = self.allocator.stats.hit_rate
@@ -551,6 +874,8 @@ class ServingEngine:
         gauges.set("serving/delivered_tokens", s["delivered_tokens"])
         gauges.set("serving/finished_requests", s["finished_requests"])
         gauges.set("serving/pending_depth", s["pending_depth"])
+        gauges.set("serving/accepted_tok_per_round", s["accepted_tok_per_round"])
+        gauges.set("serving/spec_accept_rate", s["spec_accept_rate"])
         gauges.set("serving/shed", s["shed"])
         gauges.set("serving/expired", s["expired"])
         gauges.set("serving/preempted", s["preempted"])

@@ -63,6 +63,13 @@ class Request:
     seq_blocks: Optional[SeqBlocks] = None
     slot: Optional[int] = None
     preemptions: int = 0
+    # admission rounds this request was passed over while slots were free —
+    # feeds the age-priority bonus that breaks shortest-prompt-first starvation
+    admit_waits: int = 0
+    # chunked prefill progress: prompt tokens already written to the paged
+    # cache for the CURRENT placement (device-local — reset to 0 whenever the
+    # blocks are lost: preemption or supervised replay)
+    prefilled: int = 0
 
     @property
     def done(self) -> bool:
@@ -95,6 +102,8 @@ class InflightScheduler:
         allocator: PagedBlockAllocator,
         policy: Optional[ServingResiliencePolicy] = None,
         clock=time.monotonic,
+        age_priority_after: int = 4,
+        age_priority_bonus: int = 64,
     ):
         self.num_slots = num_slots
         self.allocator = allocator
@@ -102,6 +111,13 @@ class InflightScheduler:
         # admission); None = the PR 8 behavior, byte-identical
         self.policy = policy
         self.clock = clock
+        # anti-starvation: after `age_priority_after` passed-over admission
+        # rounds, a pending request's effective sort length shrinks by
+        # `age_priority_bonus` tokens per additional round — long prompts
+        # eventually outrank any sustained stream of fresh short prompts
+        # (bonus * waits grows without bound, prompt lengths don't)
+        self.age_priority_after = age_priority_after
+        self.age_priority_bonus = age_priority_bonus
         self._uid = itertools.count()
         self._lock = threading.Lock()
         self._pending: List[Request] = []
@@ -315,6 +331,7 @@ class InflightScheduler:
             req.seq_blocks = None
         req.slot = None
         req.preemptions += 1
+        req.prefilled = 0  # blocks are gone; a re-admission re-prefills fully
         with self._lock:
             self.preempted_count += 1
             self._pending.insert(0, req)
@@ -348,8 +365,15 @@ class InflightScheduler:
             pending, self._pending = self._pending, []
         # sort on the actual prefill length (prompt + replayed generation for
         # a preempted request) so waves bucket tightly; stable sort keeps a
-        # re-queued preemption ahead of fresh arrivals of the same length
-        pending.sort(key=lambda r: len(r.prefill_ids))
+        # re-queued preemption ahead of fresh arrivals of the same length.
+        # Repeatedly passed-over requests get an age bonus that shrinks their
+        # effective length, so a long prompt cannot be starved forever by a
+        # sustained stream of short ones (admit_waits only accrues on rounds
+        # with free slots — full occupancy is not starvation)
+        pending.sort(
+            key=lambda r: len(r.prefill_ids)
+            - max(0, r.admit_waits - self.age_priority_after) * self.age_priority_bonus
+        )
         optimistic = self.policy is not None and self.policy.preemption
         placements: List[Tuple[int, Request]] = []
         kept: List[Request] = []
@@ -371,11 +395,15 @@ class InflightScheduler:
                 kept.append(req)  # capacity-blocked; retry next round
                 continue
             req.seq_blocks = seq
+            req.prefilled = 0
+            req.admit_waits = 0
             slot = free.pop(0)
             req.slot = slot
             self.slots[slot] = req
             placements.append((slot, req))
         if kept:
+            for req in kept:
+                req.admit_waits += 1
             with self._lock:  # ahead of anything submitted while we placed
                 self._pending = kept + self._pending
         return placements
@@ -397,6 +425,22 @@ class InflightScheduler:
             return self._finish(slot, FINISH_LENGTH)
         return None
 
+    def on_tokens(
+        self, slot: int, tokens: Sequence[int]
+    ) -> Tuple[Optional[Request], int]:
+        """Record a speculative round's accepted tokens in order, stopping at
+        the first one that finishes the request (tokens past a finish are
+        never emitted — exactly what step-at-a-time decode would have done).
+        Returns ``(finished request or None, tokens actually consumed)`` —
+        the consumed count is what throughput accounting may claim."""
+        consumed = 0
+        for token in tokens:
+            done = self.on_token(slot, token)
+            consumed += 1
+            if done is not None:
+                return done, consumed
+        return None, consumed
+
     # -- supervised replay ---------------------------------------------------
 
     def export_state(self) -> Dict[str, object]:
@@ -409,9 +453,11 @@ class InflightScheduler:
         live = [r for r in self.slots if r is not None]
         for req in live:
             # blocks belong to the dead allocator; drop the handles so the
-            # successor re-allocates from its own pool
+            # successor re-allocates from its own pool (and any chunked
+            # prefill progress died with the device state)
             req.seq_blocks = None
             req.slot = None
+            req.prefilled = 0
         with self._lock:
             pending = list(self._pending)
             state = {

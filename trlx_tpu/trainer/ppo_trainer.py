@@ -579,6 +579,9 @@ class PPOTrainer(MeshRLTrainer):
                 prefix_caching=cfg.prefix_caching,
                 seed=self.config.train.seed + 17,
                 policy=policy,
+                spec_k=cfg.spec_k,
+                spec_ngram=cfg.spec_ngram,
+                prefill_chunk=cfg.prefill_chunk,
             )
 
         if svr.enabled:
