@@ -478,6 +478,107 @@ def _serving_chaos_perf(jax):
     }
 
 
+def _serving_tenant_perf(jax):
+    """Multi-tenant chaos-soak leg: per-SLO-class latency tails, shed rates,
+    and fairness under sustained mixed-class traffic with every serving chaos
+    site armed (docs/serving.md "Multi-tenancy and SLO classes").
+
+    Two low-class tenants oversubscribe the engine while two high-class
+    tenants run near capacity, all through the deterministic scenario
+    harness: class-priority admission with aging, per-tenant KV quotas, and
+    class-ordered shedding, surviving supervised restarts mid-stream. The
+    quota-violation count is a hard bar — any value above zero fails the
+    run's fairness contract."""
+    import numpy as np
+
+    from trlx_tpu.models.presets import PRESETS
+    from trlx_tpu.models.transformer import TransformerLM
+    from trlx_tpu.serving import (
+        ServingEngine,
+        ServingResiliencePolicy,
+        TenantRegistry,
+        TenantTraffic,
+        run_scenario,
+    )
+    from trlx_tpu.serving.scheduler import FINISH_SHED
+
+    import jax.numpy as jnp
+
+    on_cpu = jax.default_backend() == "cpu"
+    base = PRESETS["gpt2"].replace(
+        compute_dtype=jnp.float32 if on_cpu else jnp.bfloat16
+    )
+    S, P, N, n_lo, n_hi = (3, 12, 8, 12, 6) if on_cpu else (16, 64, 32, 64, 32)
+    bs = 4 if on_cpu else 16
+    max_len = P + N + 4  # +4: the pro1 stream prepends a shared prefix
+    blocks_per_req = -(-max_len // bs)
+
+    trunk = TransformerLM(base)
+    params = trunk.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 8), jnp.int32), jnp.ones((1, 8), jnp.int32),
+    )["params"]
+
+    reg = TenantRegistry(class_ttl_s={0: 8.0, 1: 16.0})
+    reg.register("free1", slo_class=0, kv_block_quota=blocks_per_req)
+    reg.register("free2", slo_class=0, kv_block_quota=blocks_per_req)
+    reg.register("pro1", slo_class=1)
+    reg.register("pro2", slo_class=1)
+    policy = ServingResiliencePolicy(
+        max_pending=8, high_watermark=0.75, low_watermark=0.5, preemption=True
+    )
+
+    def factory():
+        return ServingEngine(
+            trunk, params, num_slots=S, max_seq_len=max_len, block_size=bs,
+            num_blocks=1 + 2 * S * blocks_per_req // 3, eos_token_id=None,
+            pad_token_id=0, gen_kwargs=dict(do_sample=False), seed=0,
+            policy=policy, prefix_caching=True, tenants=reg,
+        )
+
+    traffic = [
+        TenantTraffic("free1", num_requests=n_lo, arrivals_per_round=2.0,
+                      prompt_len=(4, P - 2), max_new=(4, N), vocab=base.vocab_size),
+        TenantTraffic("free2", num_requests=n_lo, arrivals_per_round=2.0,
+                      prompt_len=(4, P - 2), max_new=(4, N), vocab=base.vocab_size),
+        TenantTraffic("pro1", num_requests=n_hi, arrivals_per_round=0.5,
+                      prompt_len=(4, P - 2), max_new=(4, N), vocab=base.vocab_size,
+                      shared_prefix=4),
+        TenantTraffic("pro2", num_requests=n_hi, arrivals_per_round=0.5,
+                      prompt_len=(4, P - 2), max_new=(4, N), vocab=base.vocab_size),
+    ]
+    t0 = time.time()
+    report = run_scenario(
+        factory, reg, traffic,
+        chaos_spec="serving-prefill:1,serving-decode:1,serving-alloc:2,serving-wedge:1",
+        dt_s=0.05, max_rounds=800, seed=7, wedge_timeout_s=2.0 if not on_cpu else 0.25,
+    )
+    elapsed = time.time() - t0
+    submitted_by_class = {}
+    shed_by_class = {}
+    for req in report.requests.values():
+        submitted_by_class[req.slo_class] = submitted_by_class.get(req.slo_class, 0) + 1
+    for uid, reason in report.terminal.items():
+        if reason == FINISH_SHED:
+            cls = report.requests[uid].slo_class
+            shed_by_class[cls] = shed_by_class.get(cls, 0) + 1
+
+    def _rate(cls):
+        return round(shed_by_class.get(cls, 0) / max(1, submitted_by_class.get(cls, 0)), 4)
+
+    return {
+        "serving_tenant_p99_latency_s_by_class": {
+            str(c): round(v, 4) for c, v in sorted(report.p99_by_class.items())
+        },
+        "serving_tenant_shed_rate_low": _rate(0),
+        "serving_tenant_shed_rate_high": _rate(1),
+        "serving_tenant_quota_violations": int(report.quota_violations),
+        "serving_tenant_fairness_jain": round(float(report.fairness_jain), 4),
+        "serving_tenant_restarts": int(report.restarts),
+        "serving_tenant_req_s": round(report.submitted / elapsed, 2),
+    }
+
+
 def _big_perf(jax):
     """gpt2-xl-shaped (~1.56B param) single-chip leg: rollout decode + PPO train
     step with the memory machinery on — bf16 params, scan_layers, selective
@@ -776,6 +877,10 @@ def measure():
         result.update(legs.run("serving_chaos", lambda: _serving_chaos_perf(jax)))
     except Exception as e:
         result["serving_chaos_perf_error"] = f"{type(e).__name__}: {e}"[:300]
+    try:
+        result.update(legs.run("serving_tenants", lambda: _serving_tenant_perf(jax)))
+    except Exception as e:
+        result["serving_tenant_perf_error"] = f"{type(e).__name__}: {e}"[:300]
     result.update(legs.run("ir_audit", _ir_audit_probe))
     if platform != "cpu":
         try:

@@ -151,6 +151,27 @@ echo "== serving seeded-wedge gate (must recover in exactly one restart)"
 JAX_PLATFORMS=cpu TRLX_CHAOS=serving-wedge:1 timeout -k 10 300 \
     python -m pytest tests/test_serving_resilience.py -q -k seeded_wedge -p no:cacheprovider
 
+echo "== serving multi-tenant tests + scenario soak (CPU)"
+# tenancy layer: registry/quota/class-shedding/fair-preemption units plus the
+# sustained-traffic scenario soak (4 tenants, 2 SLO classes, every serving
+# chaos site, >=1 supervised restart, exactly-once terminal accounting,
+# per-class p99 ordering, zero quota violations)
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_serving_tenants.py -q -m "not slow" -p no:cacheprovider
+
+echo "== tenant seeded-starvation gate (starve_low_class must break fairness)"
+# the fairness gate proves itself like the conc/IR/spec gates: disable aging
+# for the lowest SLO class (TRLX_TENANT_SEED_REGRESSION=starve_low_class) and
+# require the anti-starvation test to FAIL — a fairness suite that passes
+# while the lowest class can be starved forever is not checking fairness
+if JAX_PLATFORMS=cpu TRLX_TENANT_SEED_REGRESSION=starve_low_class timeout -k 10 600 \
+    python -m pytest tests/test_serving_tenants.py -q -k "starved" \
+    -p no:cacheprovider > /dev/null 2>&1; then
+    echo "FATAL: seeded starve_low_class regression was NOT caught by the fairness gate" >&2
+    exit 1
+fi
+echo "seeded starve_low_class correctly rejected"
+
 echo "== chaos soak smoke (CPU)"
 # the acceptance scenario by name: producer crashes + nan-loss + bad elements
 # + reward faults in one run, every recovery visible in gauges/summary

@@ -26,6 +26,8 @@ OPEN_DICT_FIELDS = {
     "gen_experience_kwargs",
     "trainer_kwargs",
     "peft_config",
+    "tenants",  # serving_tenancy: {tenant_id: {slo_class, kv_block_quota, ...}}
+    "class_ttl_s",  # serving_tenancy: {slo_class: ttl seconds}
 }
 
 
@@ -569,6 +571,69 @@ class ServingResilienceConfig:
 
 
 @dataclass
+class ServingTenancyConfig:
+    """Multi-tenant SLO-aware serving for the continuous-batching engine
+    (``trlx_tpu/serving/tenancy.py``; docs/serving.md "Multi-tenancy and SLO
+    classes"). Only meaningful with ``train.serving.enabled``.
+
+    When enabled, the engine gains per-request tenant attribution: SLO-class
+    priority admission (higher classes first, aging prevents absolute
+    starvation), class-ordered load shedding (lowest class first, oldest
+    first within a class), per-class default TTLs, per-tenant KV-block
+    quotas with fair-share preemption, and per-tenant/per-class gauges
+    (``serving/tenant/*``, ``serving/class/*``). Off (the default) keeps the
+    serving path byte-identical to a tenant-blind engine.
+
+    :param enabled: master switch for the tenancy registry.
+    :param default_slo_class: class for tenants not listed in ``tenants``
+        (unknown tenant ids auto-register with the defaults).
+    :param default_kv_block_quota: KV-block cap for unlisted tenants;
+        0 = unlimited.
+    :param aging_class_boost_rounds: passed-over admission rounds (past the
+        scheduler's ``age_priority_after``) per +1 effective-class boost —
+        the anti-starvation dial.
+    :param class_ttl_s: per-SLO-class default request TTLs, e.g.
+        ``{0: 30.0, 1: 120.0}`` (per-tenant and per-request TTLs override).
+    :param tenants: explicit tenant contracts, e.g.
+        ``{"pro": {"slo_class": 1, "kv_block_quota": 0},
+        "free": {"slo_class": 0, "kv_block_quota": 16}}``. Keys inside each
+        entry: ``slo_class``, ``kv_block_quota``, ``request_ttl_s``.
+    """
+
+    enabled: bool = False
+    default_slo_class: int = 0
+    default_kv_block_quota: int = 0
+    aging_class_boost_rounds: int = 8
+    class_ttl_s: Dict[int, float] = field(default_factory=dict)
+    tenants: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        return cls(**config)
+
+    def build_registry(self):
+        """Materialize the :class:`~trlx_tpu.serving.tenancy.TenantRegistry`
+        this config describes (import deferred: configs must not drag the
+        serving stack in)."""
+        from trlx_tpu.serving.tenancy import TenantRegistry
+
+        registry = TenantRegistry(
+            default_slo_class=self.default_slo_class,
+            default_kv_block_quota=self.default_kv_block_quota,
+            aging_class_boost_rounds=self.aging_class_boost_rounds,
+            class_ttl_s=self.class_ttl_s,
+        )
+        for tenant_id, spec in self.tenants.items():
+            registry.register(
+                tenant_id,
+                slo_class=spec.get("slo_class"),
+                kv_block_quota=spec.get("kv_block_quota"),
+                request_ttl_s=spec.get("request_ttl_s"),
+            )
+        return registry
+
+
+@dataclass
 class TrainConfig:
     """Training loop hyperparameters (parity: ``TrainConfig``, configs.py:10-120 in reference).
 
@@ -649,6 +714,13 @@ class TrainConfig:
         default_factory=lambda: ServingResilienceConfig()
     )
 
+    # Multi-tenant SLO-aware serving (tenant registry / class priority /
+    # KV-block quotas) — see ServingTenancyConfig and docs/serving.md
+    # "Multi-tenancy and SLO classes".
+    serving_tenancy: "ServingTenancyConfig" = field(
+        default_factory=lambda: ServingTenancyConfig()
+    )
+
     # score with reward_fn on process 0 only and broadcast the results to every
     # host. None (default) = auto: ON exactly when jax.process_count() > 1 —
     # otherwise every host hits a served reward model with identical requests
@@ -696,6 +768,9 @@ class TrainConfig:
         svr = config.get("serving_resilience")
         if isinstance(svr, dict):
             config["serving_resilience"] = ServingResilienceConfig.from_dict(svr)
+        svt = config.get("serving_tenancy")
+        if isinstance(svt, dict):
+            config["serving_tenancy"] = ServingTenancyConfig.from_dict(svt)
         return cls(**config)
 
 

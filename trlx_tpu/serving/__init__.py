@@ -10,6 +10,9 @@ batching + prefix sharing). See docs/serving.md.
   (deadlines, load shedding, preemption; docs/serving.md "Fault tolerance")
 - :mod:`supervisor` — ServingSupervisor: supervised engine restarts with
   request replay under a bounded budget
+- :mod:`tenancy` — TenantRegistry: SLO classes, KV-block quotas, fair-share
+  preemption (docs/serving.md "Multi-tenancy and SLO classes")
+- :mod:`scenario` — deterministic multi-tenant chaos scenario harness
 """
 
 from trlx_tpu.serving.allocator import PagedBlockAllocator, SeqBlocks
@@ -24,10 +27,18 @@ from trlx_tpu.serving.policy import (
     RequestTooLarge,
     ServingResiliencePolicy,
 )
+from trlx_tpu.serving.scenario import ScenarioReport, TenantTraffic, run_scenario
 from trlx_tpu.serving.scheduler import InflightScheduler, Request
 from trlx_tpu.serving.supervisor import (
     ServingRestartBudgetExceeded,
     ServingSupervisor,
+)
+from trlx_tpu.serving.tenancy import (
+    DEFAULT_TENANT,
+    TenantRegistry,
+    TenantSpec,
+    jain_fairness,
+    select_victim,
 )
 
 __all__ = [
@@ -46,4 +57,12 @@ __all__ = [
     "EngineWedgedError",
     "ServingSupervisor",
     "ServingRestartBudgetExceeded",
+    "DEFAULT_TENANT",
+    "TenantRegistry",
+    "TenantSpec",
+    "select_victim",
+    "jain_fairness",
+    "TenantTraffic",
+    "ScenarioReport",
+    "run_scenario",
 ]

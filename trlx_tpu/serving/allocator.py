@@ -45,10 +45,12 @@ class AllocatorStats:
 class SeqBlocks:
     """One live sequence's physical blocks. ``num_shared`` leading blocks are
     prefix-cache hits (ref-counted, possibly backing other sequences too);
-    the rest are exclusive."""
+    the rest are exclusive. ``owner`` is the tenant the reservation counts
+    against (None = untracked / tenant-blind mode)."""
 
     blocks: List[int]
     num_shared: int = 0
+    owner: Optional[str] = None
 
 
 class PagedBlockAllocator:
@@ -67,6 +69,10 @@ class PagedBlockAllocator:
         self._block_hash: Dict[int, int] = {}
         # refcount-0 blocks with valid cached contents, LRU order
         self._cached_free: "OrderedDict[int, None]" = OrderedDict()
+        # owner -> blocks held across that owner's live sequences. A shared
+        # block counts once per holding sequence (sum == sum of refcounts),
+        # so quota checks never under-count via prefix sharing.
+        self._owner_usage: Dict[Optional[str], int] = {}
         self.stats = AllocatorStats()
 
     # -- capacity ------------------------------------------------------------
@@ -86,6 +92,38 @@ class PagedBlockAllocator:
     def can_admit(self, total_len: int) -> bool:
         """Conservative: ignores prefix hits, so admission never over-commits."""
         return self.blocks_needed(total_len) <= self.free_blocks
+
+    # -- tenant attribution --------------------------------------------------
+
+    def owner_usage(self, owner: Optional[str]) -> int:
+        """Blocks currently held across ``owner``'s live sequences."""
+        return self._owner_usage.get(owner, 0)
+
+    def owner_census(self) -> Dict[Optional[str], int]:
+        """Snapshot of per-owner block usage (quota checks / scenario
+        assertions). Sums to the total refcount — see check_invariants."""
+        return dict(self._owner_usage)
+
+    def _charge(self, owner: Optional[str], delta: int) -> None:
+        n = self._owner_usage.get(owner, 0) + delta
+        if n:
+            self._owner_usage[owner] = n
+        else:
+            self._owner_usage.pop(owner, None)
+
+    def cached_prefix_blocks(self, prompt_tokens: Sequence[int]) -> int:
+        """How many leading full blocks of this prompt the prefix cache can
+        serve right now (no state change). The scheduler's tenant-affinity
+        sort uses it to keep shared-prefix requests adjacent in admission
+        waves, before the cache churns their blocks out."""
+        if not self.prefix_caching:
+            return 0
+        hits = 0
+        for h in self._chain_hashes(prompt_tokens):
+            if h not in self._prefix:
+                break
+            hits += 1
+        return hits
 
     # -- alloc / free --------------------------------------------------------
 
@@ -110,7 +148,10 @@ class PagedBlockAllocator:
         return hashes
 
     def allocate(
-        self, prompt_tokens: Sequence[int], max_total_len: int
+        self,
+        prompt_tokens: Sequence[int],
+        max_total_len: int,
+        owner: Optional[str] = None,
     ) -> Optional[SeqBlocks]:
         """Reserve blocks covering ``max_total_len`` tokens, sharing leading
         full prompt blocks through the prefix cache. Returns None when the
@@ -152,7 +193,8 @@ class PagedBlockAllocator:
                     self._prefix[h] = block
                     self._block_hash[block] = h
             blocks.append(block)
-        return SeqBlocks(blocks=blocks, num_shared=num_shared)
+        self._charge(owner, len(blocks))
+        return SeqBlocks(blocks=blocks, num_shared=num_shared, owner=owner)
 
     def extend(self, seq: SeqBlocks, total_len: int) -> bool:
         """Grow a live sequence's reservation to cover ``total_len`` tokens
@@ -182,6 +224,7 @@ class PagedBlockAllocator:
             block = self._pop_fresh()
             self._refcount[block] = 1
             seq.blocks.append(block)
+        self._charge(seq.owner, need)
         return True
 
     def free(self, seq: SeqBlocks) -> None:
@@ -189,6 +232,7 @@ class PagedBlockAllocator:
         cancel): decref every block; blocks reaching refcount 0 either park in
         the prefix LRU (registered full prompt blocks) or return to the free
         list."""
+        self._charge(seq.owner, -len(seq.blocks))
         for block in seq.blocks:
             rc = self._refcount.get(block)
             if rc is None:
@@ -225,3 +269,12 @@ class PagedBlockAllocator:
         for h, b in self._prefix.items():
             assert self._block_hash.get(b) == h
             assert b in self._refcount or b in self._cached_free
+        # tenant attribution census: per-owner holdings must account for
+        # exactly the total of all live refcounts (a shared block counts
+        # once per holding sequence)
+        owner_total = sum(self._owner_usage.values())
+        ref_total = sum(self._refcount.values())
+        assert owner_total == ref_total, (
+            f"owner census drift: {owner_total} charged != {ref_total} held"
+        )
+        assert all(n > 0 for n in self._owner_usage.values()), "stale owner entry"

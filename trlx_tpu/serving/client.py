@@ -47,9 +47,11 @@ class GenerationClient:
         max_new_tokens: int,
         stop_sequences: Sequence[Sequence[int]] = (),
         deadline_s: Optional[float] = None,
+        tenant_id: Optional[str] = None,
     ) -> int:
         return self.engine.submit(
-            prompt, max_new_tokens, stop_sequences=stop_sequences, deadline_s=deadline_s
+            prompt, max_new_tokens, stop_sequences=stop_sequences,
+            deadline_s=deadline_s, tenant_id=tenant_id,
         )
 
     def cancel(self, uid: int) -> bool:
@@ -89,18 +91,24 @@ class GenerationClient:
                     if not req.done and not self.engine.scheduler.has_work:
                         raise EngineStoppedError(
                             f"engine drained with request uid={uid} unaccounted "
-                            f"({sent} tokens streamed)"
+                            f"({sent} tokens streamed)",
+                            tenant_id=req.tenant_id, slo_class=req.slo_class,
                         )
         for tok in req.generated[sent:]:
             yield tok
+        # typed errors carry the request's tenant attribution so callers can
+        # bill/alert per tenant without a second lookup (None-free: every
+        # request carries at least the default-tenant tags)
         if req.finish_reason == FINISH_SHED:
             raise RequestShedError(
-                f"request uid={uid} was shed after {len(req.generated)} tokens"
+                f"request uid={uid} was shed after {len(req.generated)} tokens",
+                tenant_id=req.tenant_id, slo_class=req.slo_class,
             )
         if req.finish_reason == FINISH_DEADLINE:
             raise RequestExpiredError(
                 f"request uid={uid} expired (deadline_s={req.deadline_s}) "
-                f"after {len(req.generated)} tokens"
+                f"after {len(req.generated)} tokens",
+                tenant_id=req.tenant_id, slo_class=req.slo_class,
             )
 
     # -- rollout path --------------------------------------------------------
@@ -110,6 +118,7 @@ class GenerationClient:
         prompts: List[np.ndarray],
         max_new_tokens: int,
         stop_sequences: Sequence[Sequence[int]] = (),
+        tenant_id: Optional[str] = None,
     ) -> Tuple[np.ndarray, np.ndarray, int]:
         """Continuous-batched drop-in for the one-shot generate path.
 
@@ -117,13 +126,22 @@ class GenerationClient:
         shared prompt bucket: prompts left-padded, responses padded with
         ``pad_token_id`` after finish, mask 1 on every generated token up to
         and including eos (``ops/generation.generate`` semantics — eos/stop
-        trimming stays the consumer's job, exactly as ``decode`` expects)."""
+        trimming stays the consumer's job, exactly as ``decode`` expects).
+
+        With ``tenant_id`` set, a batch member that ends shed or expired
+        raises the matching typed error (tagged with the tenant) instead of
+        silently returning a truncated row — a tenant-attributed rollout must
+        be whole or loudly not. The default-tenant path keeps returning
+        whatever outcome the engine produced, unchanged."""
         engine = self.engine
         N = int(max_new_tokens)
         P = pad_to_bucket(max((len(p) for p in prompts), default=1), PREFILL_LEN_BUCKETS)
         with self._step_lock:
             uids = [
-                engine.submit(np.asarray(p).tolist(), N, stop_sequences=stop_sequences)
+                engine.submit(
+                    np.asarray(p).tolist(), N, stop_sequences=stop_sequences,
+                    tenant_id=tenant_id,
+                )
                 for p in prompts
             ]
             done = engine.run(uids)
@@ -133,6 +151,18 @@ class GenerationClient:
         for i, (uid, p) in enumerate(zip(uids, prompts)):
             req = done[uid]
             engine.scheduler.pop_request(uid)
+            if tenant_id is not None:
+                if req.finish_reason == FINISH_SHED:
+                    raise RequestShedError(
+                        f"batch member uid={uid} was shed",
+                        tenant_id=req.tenant_id, slo_class=req.slo_class,
+                    )
+                if req.finish_reason == FINISH_DEADLINE:
+                    raise RequestExpiredError(
+                        f"batch member uid={uid} expired "
+                        f"(deadline_s={req.deadline_s})",
+                        tenant_id=req.tenant_id, slo_class=req.slo_class,
+                    )
             p = np.asarray(p, np.int32)
             gen = np.asarray(req.generated, np.int32)
             seqs[i, P - len(p):P] = p

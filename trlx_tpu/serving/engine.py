@@ -58,6 +58,7 @@ this with a lock — rollout producers call through
 
 import os
 import threading
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -77,6 +78,7 @@ from trlx_tpu.serving.policy import (
     ServingResiliencePolicy,
 )
 from trlx_tpu.serving.scheduler import InflightScheduler, Request
+from trlx_tpu.serving.tenancy import TenantRegistry, select_victim
 from trlx_tpu.utils import logging
 from trlx_tpu.utils.metrics import gauges
 
@@ -166,6 +168,7 @@ class ServingEngine:
         spec_k: int = 0,
         spec_ngram: int = 3,
         prefill_chunk: int = 0,
+        tenants: Optional[TenantRegistry] = None,
     ):
         """``trunk`` is a built ``TransformerLM`` (its config decides the KV
         dtype via ``kv_cache_quant`` and the kernel via
@@ -224,7 +227,19 @@ class ServingEngine:
         # None keeps every policy pass a no-op, byte-identical to the
         # pre-resilience engine
         self.policy = policy
-        self.scheduler = InflightScheduler(self.num_slots, self.allocator, policy=policy)
+        # tenancy registry (docs/serving.md "Multi-tenancy and SLO classes");
+        # None keeps admission/shedding/preemption tenant-blind, byte-
+        # identical to the single-tenant engine
+        self.tenants = tenants
+        self.scheduler = InflightScheduler(
+            self.num_slots, self.allocator, policy=policy, tenants=tenants
+        )
+        # per-tenant / per-class latency windows for the p99 gauges (bounded:
+        # the gauges are operational, not an unbounded history). Written only
+        # inside step() under the engine lock; export_gauges snapshots via
+        # summary()'s lock.
+        self._tenant_latency: Dict[str, deque] = {}
+        self._class_latency: Dict[int, deque] = {}
         self.stats = ServingStats()
         self._lock = threading.Lock()
         # graceful shutdown + wedge recovery: drain() flips _draining so
@@ -398,10 +413,14 @@ class ServingEngine:
         max_new_tokens: int,
         stop_sequences: Sequence[Sequence[int]] = (),
         deadline_s: Optional[float] = None,
+        tenant_id: Optional[str] = None,
     ) -> int:
+        spec = self.tenants.resolve(tenant_id) if self.tenants is not None else None
         if self._draining.is_set():
             raise EngineDrainingError(
-                "engine is draining: new requests are rejected (graceful shutdown)"
+                "engine is draining: new requests are rejected (graceful shutdown)",
+                tenant_id=spec.tenant_id if spec else None,
+                slo_class=spec.slo_class if spec else None,
             )
         if len(prompt) + max_new_tokens > self.max_seq_len:
             raise ValueError(
@@ -416,11 +435,25 @@ class ServingEngine:
             # exhaust a lone pool under optimistic admission): reject loudly
             raise RequestTooLarge(
                 f"request needs {worst} KV blocks worst-case but the pool "
-                f"holds {self.num_blocks - 1}: it can never be admitted"
+                f"holds {self.num_blocks - 1}: it can never be admitted",
+                tenant_id=spec.tenant_id if spec else None,
+                slo_class=spec.slo_class if spec else None,
+            )
+        if spec is not None and spec.kv_block_quota and worst > spec.kv_block_quota:
+            # same never-admittable logic against the tenant's own cap — and
+            # the guarantee the in-flight quota enforcement leans on: any
+            # single admitted sequence always fits its tenant's quota alone
+            raise RequestTooLarge(
+                f"request needs {worst} KV blocks worst-case but tenant "
+                f"{spec.tenant_id!r} is capped at {spec.kv_block_quota}: it "
+                f"can never be admitted",
+                tenant_id=spec.tenant_id,
+                slo_class=spec.slo_class,
             )
         return self.scheduler.submit(
             prompt, max_new_tokens, eos_token_id=self.eos_token_id,
             stop_sequences=stop_sequences, deadline_s=deadline_s,
+            tenant_id=tenant_id,
         )
 
     def cancel(self, uid: int) -> bool:
@@ -588,18 +621,70 @@ class ServingEngine:
                     self._free_slot_state(slot)
         return finished
 
+    def _tenant_shares(self) -> Dict[str, int]:
+        """Per-tenant block share for fair-share preemption: a tenant's hard
+        quota when it has one, else an equal split of the pool across the
+        tenants currently holding blocks. Exceeding the share does not fail
+        anything by itself — it just makes the tenant the preferred
+        preemption victim under KV pressure."""
+        census = self.allocator.owner_census()
+        owners = [t for t in census if t is not None]
+        fair = (self.num_blocks - 1) // max(1, len(owners))
+        return {
+            t: (self.tenants.quota(t) or fair) for t in owners
+        }
+
     def _pick_victim(self, exclude: int) -> Optional[int]:
         """Preemption victim: the live sequence with the most decode budget
         left (longest-remaining first — it would hold its blocks longest, and
         re-prefilling it re-caches the fewest finished tokens per block
-        freed). Never the slot we're trying to grow."""
+        freed). Never the slot we're trying to grow. With a tenancy registry
+        installed, candidates from over-share tenants are preferred before
+        the tenant-blind fallback (:func:`~trlx_tpu.serving.tenancy.select_victim`)."""
+        candidates = [
+            (slot, req)
+            for slot, req in enumerate(self.scheduler.slots)
+            if req is not None and slot != exclude
+        ]
+        if self.tenants is not None:
+            return select_victim(
+                candidates, self.allocator.owner_census(), self._tenant_shares()
+            )
         best, best_remaining = None, -1
-        for slot, req in enumerate(self.scheduler.slots):
-            if req is None or slot == exclude:
-                continue
+        for slot, req in candidates:
             if req.remaining_tokens > best_remaining:
                 best, best_remaining = slot, req.remaining_tokens
         return best
+
+    def _enforce_quota(self, slot: int, req: Request, need_len: int) -> None:
+        """Keep a live sequence's growth inside its tenant's KV-block quota:
+        while the extension would push the tenant over, preempt the tenant's
+        OWN longest-remaining other sequence (never another tenant's — quota
+        pressure is self-inflicted). A lone sequence always fits: submit()
+        rejects any request whose worst case exceeds its quota."""
+        quota = self.tenants.quota(req.tenant_id)
+        if not quota:
+            return
+        while True:
+            grow = self.allocator.blocks_needed(need_len) - len(req.seq_blocks.blocks)
+            if grow <= 0:
+                return
+            if self.allocator.owner_usage(req.tenant_id) + grow <= quota:
+                return
+            victim, victim_remaining = None, -1
+            for s, r in enumerate(self.scheduler.slots):
+                if r is None or s == slot or r.tenant_id != req.tenant_id:
+                    continue
+                if r.remaining_tokens > victim_remaining:
+                    victim, victim_remaining = s, r.remaining_tokens
+            if victim is None:
+                return
+            logger.warning(
+                f"quota pressure: preempting uid={self.scheduler.slots[victim].uid} "
+                f"(slot {victim}, tenant {req.tenant_id!r}) to grow slot {slot}"
+            )
+            self.scheduler.preempt(victim)
+            self._free_slot_state(victim)
 
     def _ensure_decode_capacity(self) -> None:
         """Optimistic-admission mode: before the decode step, every live slot
@@ -624,6 +709,8 @@ class ServingEngine:
                 int(self._lens[slot]) + 1 + self.spec_k,
                 len(req.prompt) + req.max_new_tokens,
             )
+            if self.tenants is not None:
+                self._enforce_quota(slot, req, need_len)
             before = len(req.seq_blocks.blocks)
             ok = (not chaos.should_fail("serving-alloc")) and self.allocator.extend(
                 req.seq_blocks, need_len
@@ -784,6 +871,13 @@ class ServingEngine:
                 self.stats.finished_requests += 1
                 if req.latency_s is not None:
                     gauges.observe("serving/request_latency_s", req.latency_s)
+                    if self.tenants is not None:
+                        self._tenant_latency.setdefault(
+                            req.tenant_id, deque(maxlen=512)
+                        ).append(req.latency_s)
+                        self._class_latency.setdefault(
+                            req.slo_class, deque(maxlen=512)
+                        ).append(req.latency_s)
             return finished
 
     def begin_drain(self, shed_pending: bool = True) -> None:
@@ -866,6 +960,14 @@ class ServingEngine:
             out[key] = float(count)
         return out
 
+    @staticmethod
+    def _p99(window: Sequence[float]) -> float:
+        """Nearest-rank p99 over a latency window (0.0 when empty)."""
+        xs = sorted(window)
+        if not xs:
+            return 0.0
+        return xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+
     def export_gauges(self) -> None:
         s = self.summary()
         gauges.set("serving/slot_occupancy", s["mean_slot_occupancy"])
@@ -879,3 +981,37 @@ class ServingEngine:
         gauges.set("serving/shed", s["shed"])
         gauges.set("serving/expired", s["expired"])
         gauges.set("serving/preempted", s["preempted"])
+        if self.tenants is None:
+            return
+        # per-tenant / per-SLO-class breakdowns (satellite: serving/tenant/*
+        # and serving/class/* ride the same registry; ServingEngine.close()
+        # clears the whole serving/ prefix)
+        tenant_counts = self.scheduler.tenant_outcome_counts()
+        # zero-fill every registered tenant so dashboards see stable keys
+        # even before a tenant's first shed/expiry/preemption
+        for tid in set(self.tenants.tenant_ids()) | set(tenant_counts):
+            counts = tenant_counts.get(tid, {})
+            for key in ("shed", "expired", "preempted"):
+                gauges.set(f"serving/tenant/{tid}/{key}", float(counts.get(key, 0)))
+        for cls, counts in self.scheduler.class_outcome_counts().items():
+            for key in ("shed", "expired", "preempted"):
+                gauges.set(f"serving/class/{cls}/{key}", float(counts.get(key, 0)))
+        with self._lock:
+            tenant_lat = {t: list(w) for t, w in self._tenant_latency.items()}
+            class_lat = {c: list(w) for c, w in self._class_latency.items()}
+        for tid, window in tenant_lat.items():
+            gauges.set(f"serving/tenant/{tid}/p99_latency_s", self._p99(window))
+        for cls, window in class_lat.items():
+            gauges.set(f"serving/class/{cls}/p99_latency_s", self._p99(window))
+        for tid, used in self.allocator.owner_census().items():
+            if tid is not None:
+                gauges.set(f"serving/tenant/{tid}/blocks_in_use", float(used))
+
+    def close(self) -> None:
+        """Retire this engine's observability surface: clear every gauge
+        under the serving/ prefix (GaugeRegistry.clear is prefix-aware), so
+        a later engine in the same process starts from a clean slate.
+        Callers that want final values snapshot them BEFORE close — the
+        supervisor deliberately does not call this, its tests read gauges
+        after shutdown."""
+        gauges.clear(prefix="serving/")

@@ -24,36 +24,55 @@ from dataclasses import dataclass
 from typing import Optional
 
 
-class RequestTooLarge(ValueError):
-    """The request's worst-case block need exceeds the whole pool: it could
-    never be admitted and would previously pend (and spin its client)
-    forever. Raised at ``submit`` — reject early, loudly."""
+class _TenantTagged:
+    """Mixin carrying tenant attribution on typed serving errors: callers in
+    a multi-tenant deployment attribute failures (which tenant's request was
+    shed/expired, at what SLO class) straight off the exception instead of
+    re-looking the request up. Both fields are None on the default-tenant
+    path — constructing with a bare message stays source-compatible."""
+
+    def __init__(
+        self,
+        *args,
+        tenant_id: Optional[str] = None,
+        slo_class: Optional[int] = None,
+    ):
+        super().__init__(*args)
+        self.tenant_id = tenant_id
+        self.slo_class = slo_class
 
 
-class RequestShedError(RuntimeError):
+class RequestTooLarge(_TenantTagged, ValueError):
+    """The request's worst-case block need exceeds the whole pool — or its
+    tenant's KV-block quota: it could never be admitted and would previously
+    pend (and spin its client) forever. Raised at ``submit`` — reject early,
+    loudly."""
+
+
+class RequestShedError(_TenantTagged, RuntimeError):
     """The request was shed under admission pressure (bounded pending queue
     over its high watermark, or engine drain). Accountable: the request holds
     ``finish_reason == "shed"`` and whatever tokens were decoded before the
     shed; raised by ``GenerationClient.stream`` after yielding them."""
 
 
-class RequestExpiredError(RuntimeError):
+class RequestExpiredError(_TenantTagged, RuntimeError):
     """The request passed its wall-clock deadline (TTL) or its
     max-pending-age while queued. ``finish_reason == "deadline"``."""
 
 
-class EngineDrainingError(RuntimeError):
+class EngineDrainingError(_TenantTagged, RuntimeError):
     """``submit`` was called on a draining/drained engine — graceful shutdown
     rejects new work instead of accepting requests it will never run."""
 
 
-class EngineStoppedError(RuntimeError):
+class EngineStoppedError(_TenantTagged, RuntimeError):
     """The engine stopped making progress for a live stream: it drained with
     the request unaccounted, or a supervised restart budget was exhausted.
     Raised by ``GenerationClient.stream`` instead of spinning forever."""
 
 
-class EngineWedgedError(RuntimeError):
+class EngineWedgedError(_TenantTagged, RuntimeError):
     """The engine's decode loop wedged (no decode-round heartbeat) and was
     aborted — by the watchdog escalation or the supervisor's per-round wedge
     timer. The supervisor treats this like a crash: rebuild and replay."""

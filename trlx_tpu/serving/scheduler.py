@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from trlx_tpu.serving.allocator import PagedBlockAllocator, SeqBlocks
 from trlx_tpu.serving.policy import ServingResiliencePolicy
+from trlx_tpu.serving.tenancy import DEFAULT_TENANT, TenantRegistry
 
 FINISH_EOS = "eos"
 FINISH_STOP = "stop_sequence"
@@ -57,6 +58,12 @@ class Request:
     submitted_at: float = 0.0
     deadline_s: Optional[float] = None
     finished_at: Optional[float] = None
+    # tenancy (docs/serving.md "Multi-tenancy and SLO classes"): every
+    # request runs under a tenant; higher slo_class = admitted first, shed
+    # last. Untagged traffic carries the defaults and behaves exactly as in
+    # the tenant-blind engine.
+    tenant_id: str = DEFAULT_TENANT
+    slo_class: int = 0
     # -- filled in by the scheduler/engine --
     generated: List[int] = field(default_factory=list)
     finish_reason: Optional[str] = None
@@ -104,12 +111,16 @@ class InflightScheduler:
         clock=time.monotonic,
         age_priority_after: int = 4,
         age_priority_bonus: int = 64,
+        tenants: Optional[TenantRegistry] = None,
     ):
         self.num_slots = num_slots
         self.allocator = allocator
         # fault-tolerance policy (deadlines / shedding / optimistic
         # admission); None = the PR 8 behavior, byte-identical
         self.policy = policy
+        # tenancy registry (SLO classes / quotas / per-class TTLs); None =
+        # tenant-blind scheduling, byte-identical to the pre-tenancy engine
+        self.tenants = tenants
         self.clock = clock
         # anti-starvation: after `age_priority_after` passed-over admission
         # rounds, a pending request's effective sort length shrinks by
@@ -135,6 +146,11 @@ class InflightScheduler:
         self.shed_count = 0
         self.expired_count = 0
         self.preempted_count = 0
+        # per-tenant / per-SLO-class outcome breakdowns (same write sites as
+        # the global counters, same lock; exported as serving/tenant/* and
+        # serving/class/* gauges and carried across supervised restarts)
+        self.tenant_counts: Dict[str, Dict[str, int]] = {}
+        self.class_counts: Dict[int, Dict[str, int]] = {}
         # highest uid ever issued + 1: a successor scheduler (supervised
         # restart) resumes the counter here so client-held uids stay unique
         self.uid_hwm = 0
@@ -149,7 +165,18 @@ class InflightScheduler:
         eos_token_id: Optional[int] = None,
         stop_sequences: Sequence[Sequence[int]] = (),
         deadline_s: Optional[float] = None,
+        tenant_id: Optional[str] = None,
     ) -> int:
+        # deadline precedence: explicit per-request TTL > tenant TTL > class
+        # TTL > policy TTL (the first two live in the registry's ttl_for)
+        tid, slo_class = DEFAULT_TENANT, 0
+        if self.tenants is not None:
+            spec = self.tenants.resolve(tenant_id)
+            tid, slo_class = spec.tenant_id, spec.slo_class
+            if deadline_s is None:
+                deadline_s = self.tenants.ttl_for(spec)
+        elif tenant_id is not None:
+            tid = str(tenant_id)
         if deadline_s is None and self.policy is not None:
             deadline_s = self.policy.request_ttl_s
         with self._lock:
@@ -164,6 +191,8 @@ class InflightScheduler:
                 stop_sequences=tuple(tuple(map(int, s)) for s in stop_sequences if len(s)),
                 submitted_at=self.clock(),
                 deadline_s=deadline_s,
+                tenant_id=tid,
+                slo_class=slo_class,
             )
             self._pending.append(req)
             self.requests[req.uid] = req
@@ -235,6 +264,14 @@ class InflightScheduler:
             self.finished[req.uid] = req
         return req
 
+    def _count_outcome(self, req: Request, key: str) -> None:
+        """Bump the per-tenant and per-class breakdown for one fault outcome
+        (shed/expired/preempted). Caller holds ``_lock``."""
+        t = self.tenant_counts.setdefault(req.tenant_id, {})  # graftcheck: noqa[TH001] — every call site holds _lock
+        t[key] = t.get(key, 0) + 1
+        c = self.class_counts.setdefault(req.slo_class, {})  # graftcheck: noqa[TH001] — every call site holds _lock
+        c[key] = c.get(key, 0) + 1
+
     # -- fault-tolerance rounds (no-ops without a policy) --------------------
 
     def expire_and_shed_pending(self) -> List[Request]:
@@ -261,15 +298,21 @@ class InflightScheduler:
                     req.finished_at = now
                     self.finished[req.uid] = req
                     self.expired_count += 1
+                    self._count_outcome(req, "expired")
                     out.append(req)
                 else:
                     kept.append(req)
             self._pending = kept
             trigger = policy.shed_trigger
             if trigger and len(self._pending) > trigger:
-                # oldest-first: they have waited longest and are closest to
-                # expiring anyway; preserve submit order among the survivors
-                by_age = sorted(self._pending, key=lambda r: r.submitted_at)
+                # strictly class-ordered: the lowest SLO class sheds first,
+                # oldest-first within a class (they have waited longest and
+                # are closest to expiring anyway). With every request in one
+                # class this degenerates to the tenant-blind oldest-first
+                # order. Preserve submit order among the survivors.
+                by_age = sorted(
+                    self._pending, key=lambda r: (r.slo_class, r.submitted_at)
+                )
                 to_shed = set(
                     id(r) for r in by_age[: len(self._pending) - policy.shed_target]
                 )
@@ -280,6 +323,7 @@ class InflightScheduler:
                         req.finished_at = now
                         self.finished[req.uid] = req
                         self.shed_count += 1
+                        self._count_outcome(req, "shed")
                         out.append(req)
                     else:
                         kept.append(req)
@@ -299,6 +343,7 @@ class InflightScheduler:
                 req.finished_at = now
                 self.finished[req.uid] = req
                 self.shed_count += 1
+                self._count_outcome(req, "shed")
         return pending
 
     def expire_live(self) -> List[Tuple[int, Request]]:
@@ -315,6 +360,8 @@ class InflightScheduler:
         if freed:
             with self._lock:  # counters are read by gauge/bench threads
                 self.expired_count += len(freed)
+                for _, req in freed:
+                    self._count_outcome(req, "expired")
         return freed
 
     def preempt(self, slot: int) -> Request:
@@ -334,6 +381,7 @@ class InflightScheduler:
         req.prefilled = 0  # blocks are gone; a re-admission re-prefills fully
         with self._lock:
             self.preempted_count += 1
+            self._count_outcome(req, "preempted")
             self._pending.insert(0, req)
         return req
 
@@ -370,10 +418,38 @@ class InflightScheduler:
         # effective length, so a long prompt cannot be starved forever by a
         # sustained stream of short ones (admit_waits only accrues on rounds
         # with free slots — full occupancy is not starvation)
-        pending.sort(
-            key=lambda r: len(r.prefill_ids)
-            - max(0, r.admit_waits - self.age_priority_after) * self.age_priority_bonus
-        )
+        reg = self.tenants
+        if reg is None:
+            pending.sort(
+                key=lambda r: len(r.prefill_ids)
+                - max(0, r.admit_waits - self.age_priority_after)
+                * self.age_priority_bonus
+            )
+        else:
+            # SLO-class priority admission: higher classes place first; the
+            # within-class order is the tenant-blind key minus a prefix-cache
+            # affinity discount (cached leading blocks cost nothing to
+            # prefill, and admitting shared-prefix requests together keeps
+            # their hit rate before the LRU churns the blocks out). Aging
+            # still forbids absolute starvation: after enough passed-over
+            # rounds the *effective class* itself rises, so a low-class
+            # request eventually outranks any sustained high-class stream.
+            bs = self.allocator.block_size
+
+            def _key(r: Request):
+                bonus_rounds = max(0, r.admit_waits - self.age_priority_after)
+                if not reg.aging_enabled(r.slo_class):
+                    bonus_rounds = 0
+                eff_class = r.slo_class + bonus_rounds // reg.aging_class_boost_rounds
+                prefill = r.prefill_ids
+                eff_len = (
+                    len(prefill)
+                    - self.allocator.cached_prefix_blocks(prefill) * bs
+                    - bonus_rounds * self.age_priority_bonus
+                )
+                return (-eff_class, eff_len)
+
+            pending.sort(key=_key)
         optimistic = self.policy is not None and self.policy.preemption
         placements: List[Tuple[int, Request]] = []
         kept: List[Request] = []
@@ -390,7 +466,21 @@ class InflightScheduler:
                 len(prefill) + 1 if optimistic
                 else len(req.prompt) + req.max_new_tokens
             )
-            seq = self.allocator.allocate(prefill, reserve)
+            if reg is not None:
+                quota = reg.quota(req.tenant_id)
+                if quota and (
+                    self.allocator.owner_usage(req.tenant_id)
+                    + self.allocator.blocks_needed(reserve)
+                    > quota
+                ):
+                    # placing this request would push its tenant over quota;
+                    # it waits for the tenant's own live sequences to finish
+                    # (slots stay available to other tenants)
+                    kept.append(req)
+                    continue
+            seq = self.allocator.allocate(
+                prefill, reserve, owner=req.tenant_id if reg is not None else None
+            )
             if seq is None:
                 kept.append(req)  # capacity-blocked; retry next round
                 continue
@@ -470,6 +560,8 @@ class InflightScheduler:
                     self.shed_count, self.expired_count, self.preempted_count,
                     self.steps, self.occupied_slot_steps,
                 ),
+                "tenant_counts": {t: dict(c) for t, c in self.tenant_counts.items()},
+                "class_counts": {k: dict(c) for k, c in self.class_counts.items()},
             }
         return state
 
@@ -492,6 +584,16 @@ class InflightScheduler:
             self.preempted_count += preempted
             self.steps += steps
             self.occupied_slot_steps += occupied
+            # tenant attribution survives restarts (absent in pre-tenancy
+            # snapshots — .get keeps old exports adoptable)
+            for tid, counts in state.get("tenant_counts", {}).items():
+                t = self.tenant_counts.setdefault(tid, {})
+                for key, n in counts.items():
+                    t[key] = t.get(key, 0) + n
+            for cls, counts in state.get("class_counts", {}).items():
+                c = self.class_counts.setdefault(cls, {})
+                for key, n in counts.items():
+                    c[key] = c.get(key, 0) + n
 
     def note_step(self) -> None:
         # locked: the occupancy gauge (bench/obs threads) reads these counters
@@ -515,3 +617,13 @@ class InflightScheduler:
                 "expired": self.expired_count,
                 "preempted": self.preempted_count,
             }
+
+    def tenant_outcome_counts(self) -> Dict[str, Dict[str, int]]:
+        """Per-tenant outcome breakdown (locked snapshot for gauges)."""
+        with self._lock:
+            return {t: dict(c) for t, c in self.tenant_counts.items()}
+
+    def class_outcome_counts(self) -> Dict[int, Dict[str, int]]:
+        """Per-SLO-class outcome breakdown (locked snapshot for gauges)."""
+        with self._lock:
+            return {k: dict(c) for k, c in self.class_counts.items()}

@@ -124,6 +124,10 @@ class ServingSupervisor:
         return self.engine.pad_token_id
 
     @property
+    def tenants(self):
+        return self.engine.tenants
+
+    @property
     def num_blocks(self) -> int:
         return self.engine.num_blocks
 

@@ -563,6 +563,10 @@ class PPOTrainer(MeshRLTrainer):
                 low_watermark=svr.low_watermark,
                 preemption=svr.preemption,
             )
+        svt = self.config.train.serving_tenancy
+        # one registry across engine generations: tenant contracts (and the
+        # aging policy) survive supervised restarts by construction
+        tenants = svt.build_registry() if svt.enabled else None
 
         def build_engine():
             return ServingEngine(
@@ -582,6 +586,7 @@ class PPOTrainer(MeshRLTrainer):
                 spec_k=cfg.spec_k,
                 spec_ngram=cfg.spec_ngram,
                 prefill_chunk=cfg.prefill_chunk,
+                tenants=tenants,
             )
 
         if svr.enabled:
@@ -605,7 +610,8 @@ class PPOTrainer(MeshRLTrainer):
             f"serving engine enabled: slots={num_slots}, "
             f"block_size={cfg.block_size}, blocks={self._serving_engine.num_blocks}, "
             f"int8_kv={trunk_config.kv_cache_quant}, impl={cfg.attention_impl}, "
-            f"resilience={'on' if svr.enabled else 'off'}"
+            f"resilience={'on' if svr.enabled else 'off'}, "
+            f"tenancy={'on' if svt.enabled else 'off'}"
         )
 
     def _serving_generate(self, prompts, params=None):
