@@ -29,6 +29,7 @@ missing. Failed legs are never recorded.
 import json
 import os
 import sys
+import tempfile
 import time
 from functools import partial
 
@@ -579,6 +580,125 @@ def _serving_tenant_perf(jax):
     }
 
 
+def _serving_overlap_perf(jax):
+    """Stream-overlapped PPO leg (docs/serving.md "Stream-overlapped PPO"):
+    how much of the decode window the streaming pipeline fills with
+    reward/score/learn-stage work, and what bubble remains.
+
+    A tiny char-LM PPO trainer runs one serving rollout phase twice — a
+    compile warmup, then a measured phase — with 2 decode slots over 8
+    prompts so completions stagger into waves and each wave's reward calls
+    (a deliberate 30 ms stand-in for a reward RPC) land while later waves
+    are still decoding. Keys:
+
+    - ``serving_overlap_fraction``: overlapped work time / decode-busy time
+      from the engine's summary delta (the same ledger the
+      ``serving/overlap_fraction`` gauge exports; can exceed 1.0 with
+      multiple reward workers). The CPU-soak acceptance bar is >= 0.5.
+    - ``ppo_step_bubble_s``: reward+score+stage seconds that did NOT overlap
+      decode — the serial residue a bigger model would expose.
+    - ``ppo_step_time_s_overlap``: wall time of the streamed experience
+      phase plus one PPO epoch consuming the staged learner batches.
+    """
+    import numpy as np
+
+    from trlx_tpu.data.configs import (
+        MeshConfig, ModelConfig, OptimizerConfig, SchedulerConfig,
+        ServingConfig, TokenizerConfig, TrainConfig, TRLConfig,
+    )
+    from trlx_tpu.methods.ppo import PPOConfig
+    from trlx_tpu.obs.spans import tracer
+    from trlx_tpu.parallel import mesh as mesh_lib
+    from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
+    from trlx_tpu.utils.loading import get_trainer
+
+    alphabet = "abcdefgh "
+    tmp = tempfile.mkdtemp(prefix="trlx-overlap-bench-")
+    config = TRLConfig(
+        method=PPOConfig(
+            num_rollouts=8, chunk_size=8, ppo_epochs=1, init_kl_coef=0.01,
+            target=None, gen_kwargs=dict(max_new_tokens=12, do_sample=False),
+        ),
+        train=TrainConfig(
+            seq_length=32, epochs=1, total_steps=1, batch_size=4, minibatch_size=2,
+            checkpoint_interval=100, eval_interval=100,
+            checkpoint_dir=os.path.join(tmp, "ckpts"), pipeline="PromptPipeline",
+            trainer="PPOTrainer", tracker=None, seed=2,
+            serving=ServingConfig(
+                enabled=True, num_slots=2, block_size=4, stream_overlap=True,
+                overlap_microbucket=2, overlap_reward_workers=2,
+            ),
+        ),
+        model=ModelConfig(
+            model_path="gpt2", num_layers_unfrozen=-1,
+            model_overrides=dict(
+                vocab_size=len(alphabet) + 3, hidden_size=32, num_layers=2,
+                num_heads=2, intermediate_size=64, max_position_embeddings=64,
+            ),
+        ),
+        tokenizer=TokenizerConfig(tokenizer_path=f"char://{alphabet}"),
+        optimizer=OptimizerConfig(name="adamw", kwargs=dict(lr=1e-3)),
+        scheduler=SchedulerConfig(
+            name="cosine_annealing", kwargs=dict(T_max=100, eta_min=1e-3)
+        ),
+        mesh=MeshConfig(data=1, fsdp=1, model=1, compute_dtype="float32"),
+    )
+
+    def reward_fn(samples, **kw):
+        time.sleep(0.03 * len(samples))  # stand-in for a reward-model RPC
+        return [float(s.count("a")) for s in samples]
+
+    # serving (and thus the streamed path) requires a single-device mesh
+    real_mesh_from_config = mesh_lib.mesh_from_config
+    mesh_lib.mesh_from_config = lambda cfg, devices=None: mesh_lib.make_mesh(
+        data=1, fsdp=1, model=1, devices=jax.devices()[:1]
+    )
+    try:
+        trainer = get_trainer("PPOTrainer")(config=config, reward_fn=reward_fn)
+        prompts = ["ab", "cd ef", "gh", "a b c", "ba", "fe dc", "hg", "c b a"]
+        trainer.add_prompt_pipeline(PromptPipeline(prompts, 12, trainer.tokenizer))
+        trainer._resolve_serving()
+        if trainer._serving_client is None:
+            return {"serving_overlap_perf_error": "serving fell back to generate path"}
+
+        # warmup: compiles every prefill bucket, the decode step, the bucketed
+        # score fn, and the train step (first-compile must not pollute the
+        # overlap ledger delta)
+        trainer.prepare_learning()
+        trainer.store.clear_history()
+        trainer.make_experience(8, 0)
+        for b in trainer.create_train_dataloader():
+            trainer.train_step(b)
+
+        before = trainer._serving_engine.summary()
+        tracer.configure(enabled=True)
+        tracer.drain_step_times()
+        t0 = time.time()
+        trainer.store.clear_history()
+        trainer.make_experience(8, 1)
+        for b in trainer.create_train_dataloader():
+            trainer.train_step(b)
+        step_wall = time.time() - t0
+        spans = tracer.drain_step_times()
+        tracer.configure(enabled=False)
+        after = trainer._serving_engine.summary()
+
+        decode_s = after["overlap_decode_s"] - before["overlap_decode_s"]
+        overlapped_s = after["overlap_overlapped_s"] - before["overlap_overlapped_s"]
+        work_s = sum(
+            v for k, v in spans.items()
+            if k.split("time/span/")[-1] in
+            ("reward", "decode.score", "decode.learn_stage", "score", "learn_stage")
+        )
+        return {
+            "serving_overlap_fraction": round(overlapped_s / max(1e-9, decode_s), 4),
+            "ppo_step_bubble_s": round(max(0.0, work_s - overlapped_s), 4),
+            "ppo_step_time_s_overlap": round(step_wall, 4),
+        }
+    finally:
+        mesh_lib.mesh_from_config = real_mesh_from_config
+
+
 def _big_perf(jax):
     """gpt2-xl-shaped (~1.56B param) single-chip leg: rollout decode + PPO train
     step with the memory machinery on — bf16 params, scan_layers, selective
@@ -881,6 +1001,10 @@ def measure():
         result.update(legs.run("serving_tenants", lambda: _serving_tenant_perf(jax)))
     except Exception as e:
         result["serving_tenant_perf_error"] = f"{type(e).__name__}: {e}"[:300]
+    try:
+        result.update(legs.run("serving_overlap", lambda: _serving_overlap_perf(jax)))
+    except Exception as e:
+        result["serving_overlap_perf_error"] = f"{type(e).__name__}: {e}"[:300]
     result.update(legs.run("ir_audit", _ir_audit_probe))
     if platform != "cpu":
         try:

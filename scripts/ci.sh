@@ -172,6 +172,34 @@ if JAX_PLATFORMS=cpu TRLX_TENANT_SEED_REGRESSION=starve_low_class timeout -k 10 
 fi
 echo "seeded starve_low_class correctly rejected"
 
+echo "== stream-overlap tests (CPU)"
+# stream-overlapped PPO: reorder-buffer determinism, overlap interval ledger,
+# bounded score-fn bucket families, staged-learn seam units; bounded so a
+# deadlocked reward pool or a stalled reorder cursor fails fast
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_serving_overlap.py -q -m "not slow" -p no:cacheprovider
+
+echo "== stream-overlap fraction proof (CPU)"
+# the acceptance scenario by name: a streamed rollout on CPU must overlap
+# >= 0.5 of its decode-busy time with reward/score/stage work, with score
+# spans nested inside the decode span (live measurement, not a unit mock)
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_serving_overlap.py -q -k "fraction and not serialize" \
+    -p no:cacheprovider
+
+echo "== overlap seeded-serialize gate (serialize must collapse the fraction)"
+# the overlap gate proves itself like the conc/IR/spec gates: force serial
+# in-memory consumption (TRLX_OVERLAP_SEED_REGRESSION=serialize blocks the
+# decode loop on every reward) and require the overlap-fraction proof to
+# FAIL — a pipeline that quietly serializes must not report overlap
+if JAX_PLATFORMS=cpu TRLX_OVERLAP_SEED_REGRESSION=serialize timeout -k 10 600 \
+    python -m pytest tests/test_serving_overlap.py -q -k "fraction and not serialize" \
+    -p no:cacheprovider > /dev/null 2>&1; then
+    echo "FATAL: seeded serialize regression was NOT caught by the overlap-fraction gate" >&2
+    exit 1
+fi
+echo "seeded serialize correctly rejected"
+
 echo "== chaos soak smoke (CPU)"
 # the acceptance scenario by name: producer crashes + nan-loss + bad elements
 # + reward faults in one run, every recovery visible in gauges/summary

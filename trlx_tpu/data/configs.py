@@ -494,6 +494,22 @@ class ServingConfig:
         chunks of this many tokens, interleaved one chunk per decode round so
         long prompts stop stalling in-flight decode (0 = whole-prompt
         prefill). End state per sequence is identical to unchunked prefill.
+    :param stream_overlap: stream-overlapped PPO experience (docs/serving.md
+        "Stream-overlapped PPO") — score and stage learner batches while the
+        tail of the rollout batch is still decoding. As each sequence finishes
+        in the engine its reward_fn call is dispatched from a bounded worker
+        pool, scored sequences are batched into fixed-shape microbuckets for
+        the jitted score fn, and first-epoch learner microbatches are staged
+        onto the device — all inside the decode window. Off (the default)
+        keeps the serving experience path byte-identical to the serial one;
+        on, greedy rollout contents and store order are unchanged, only
+        wall-clock (and score-normalization grouping) differs.
+    :param overlap_reward_workers: bounded reward_fn worker pool size for the
+        streaming path.
+    :param overlap_microbucket: sequences per scoring microbucket; 0 = the
+        rollout chunk size.
+    :param overlap_learn_stage: also pre-stage first-epoch learner
+        microbatches (collate + ``device_put``) during the streaming window.
     """
 
     enabled: bool = False
@@ -506,6 +522,10 @@ class ServingConfig:
     spec_k: int = 0
     spec_ngram: int = 3
     prefill_chunk: int = 0
+    stream_overlap: bool = False
+    overlap_reward_workers: int = 2
+    overlap_microbucket: int = 0
+    overlap_learn_stage: bool = True
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):

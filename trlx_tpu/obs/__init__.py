@@ -17,6 +17,7 @@ facade the trainer drives from ``TRLConfig.train.observability``:
 """
 
 from trlx_tpu.obs.memory import device_memory_stats, host_rss_bytes
+from trlx_tpu.obs.overlap import OverlapWindow
 from trlx_tpu.obs.runtime import Observability, batch_token_count
 from trlx_tpu.obs.spans import SpanTracer, span, tracer
 from trlx_tpu.obs.throughput import (
@@ -30,6 +31,7 @@ from trlx_tpu.obs.watchdog import StallWatchdog, format_all_stacks, watchdog
 
 __all__ = [
     "Observability",
+    "OverlapWindow",
     "PEAK_TFLOPS_BY_DEVICE_KIND",
     "SpanTracer",
     "StallWatchdog",

@@ -182,6 +182,16 @@ class SpanTracer:
             json.dump(doc, f)
         return path
 
+    def snapshot_events(self) -> List[Dict[str, Any]]:
+        """Copy of the accumulated trace events (requires ``trace_path``).
+
+        Lets tests and bench legs verify time-window relationships between
+        spans on different threads (e.g. reward spans nested inside the decode
+        span during stream-overlapped PPO) without writing a trace file.
+        """
+        with self._lock:
+            return [dict(ev) for ev in self._events]
+
     def reset(self):
         """Drop all accumulated state (tests / a fresh training run)."""
         with self._lock:

@@ -29,6 +29,7 @@ default and ``max_staleness=0`` falls back to it exactly.
 from trlx_tpu.rollout.engine import AsyncRolloutEngine
 from trlx_tpu.rollout.publisher import ParameterPublisher
 from trlx_tpu.rollout.queue import ExperienceQueue, QueueClosed
+from trlx_tpu.rollout.reorder import ReorderBuffer
 from trlx_tpu.rollout.staleness import StalenessAccountant, staleness_importance_weights
 from trlx_tpu.rollout.supervisor import ProducerRestartBudgetExceeded, ProducerSupervisor
 
@@ -39,6 +40,7 @@ __all__ = [
     "ProducerRestartBudgetExceeded",
     "ProducerSupervisor",
     "QueueClosed",
+    "ReorderBuffer",
     "StalenessAccountant",
     "staleness_importance_weights",
 ]

@@ -18,7 +18,8 @@ The client serializes engine stepping: concurrent ``generate_batch`` /
 """
 
 import threading
-from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+import time
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -163,6 +164,86 @@ class GenerationClient:
                         f"(deadline_s={req.deadline_s})",
                         tenant_id=req.tenant_id, slo_class=req.slo_class,
                     )
+            p = np.asarray(p, np.int32)
+            gen = np.asarray(req.generated, np.int32)
+            seqs[i, P - len(p):P] = p
+            seqs[i, P:P + len(gen)] = gen
+            mask[i, : len(gen)] = 1
+        return seqs, mask, P
+
+    def stream_batch(
+        self,
+        prompts: List[np.ndarray],
+        max_new_tokens: int,
+        on_finish: Callable[[int, Request], None],
+        stop_sequences: Sequence[Sequence[int]] = (),
+        on_step: Optional[Callable[[float, float], None]] = None,
+    ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """:meth:`generate_batch` with per-sequence completion callbacks —
+        the seam for stream-overlapped PPO (docs/serving.md).
+
+        ``on_finish(i, req)`` fires exactly once per batch index ``i``, on the
+        calling thread, as soon as the engine finishes that sequence — while
+        the rest of the batch is still decoding. It runs under the client's
+        step lock between engine rounds, so it must hand heavy work (reward
+        RPCs, scoring) to another thread and return quickly; anything it
+        blocks on stalls decode. Exactly-once holds across supervised engine
+        restarts: a finished request adopted by a new engine generation is
+        de-duplicated by uid before delivery.
+
+        ``on_step(t0, t1)`` receives the ``time.perf_counter`` window of every
+        engine round — the decode busy intervals the overlap ledger needs.
+
+        Returns the same ``(sequences [B, P+N], response_mask [B, N], P)``
+        contract as :meth:`generate_batch`.
+        """
+        engine = self.engine
+        N = int(max_new_tokens)
+        P = pad_to_bucket(max((len(p) for p in prompts), default=1), PREFILL_LEN_BUCKETS)
+        done: Dict[int, Request] = {}
+
+        with self._step_lock:
+            uids = [
+                engine.submit(np.asarray(p).tolist(), N, stop_sequences=stop_sequences)
+                for p in prompts
+            ]
+            index_of = {uid: i for i, uid in enumerate(uids)}
+            want = set(uids)
+
+            def _deliver(finished: Dict[int, Request]) -> None:
+                for uid, req in finished.items():
+                    if uid in done:  # restart carry-over: already delivered
+                        continue
+                    done[uid] = req
+                    idx = index_of.get(uid)
+                    if idx is not None:
+                        on_finish(idx, req)
+
+            _deliver(dict(engine.scheduler.pop_finished()))
+            while not (want <= set(done)):
+                if not engine.scheduler.has_work:
+                    raise EngineStoppedError(
+                        f"engine drained with requests unaccounted: "
+                        f"{want - set(done)}"
+                    )
+                t0 = time.perf_counter()
+                # same contract as generate_batch/stream: the step lock IS the
+                # serialization — one caller drives rounds of one continuous
+                # batch, and on_finish fires between rounds under it (heavy
+                # work is the callback's job to offload, see docstring)
+                engine.step()  # graftcheck: noqa[CC005]
+                t1 = time.perf_counter()
+                if on_step is not None:
+                    on_step(t0, t1)
+                _deliver(dict(engine.scheduler.pop_finished()))
+                engine.export_gauges()
+
+        B = len(prompts)
+        seqs = np.full((B, P + N), engine.pad_token_id, np.int32)
+        mask = np.zeros((B, N), np.int32)
+        for i, (uid, p) in enumerate(zip(uids, prompts)):
+            req = done[uid]
+            engine.scheduler.pop_request(uid)
             p = np.asarray(p, np.int32)
             gen = np.asarray(req.generated, np.int32)
             seqs[i, P - len(p):P] = p

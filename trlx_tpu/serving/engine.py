@@ -146,6 +146,12 @@ class ServingStats:
     spec_draft_tokens: int = 0
     spec_accepted_tokens: int = 0
     chunk_appends: int = 0
+    # stream-overlapped PPO (docs/serving.md "Stream-overlapped PPO"): the
+    # trainer reports each streaming window's decode-busy seconds and the
+    # reward/score/learn-stage seconds that genuinely overlapped them
+    overlap_decode_s: float = 0.0
+    overlap_overlapped_s: float = 0.0
+    overlap_windows: int = 0
 
 
 class ServingEngine:
@@ -928,6 +934,15 @@ class ServingEngine:
 
     # -- observability -------------------------------------------------------
 
+    def note_overlap(self, decode_busy_s: float, overlapped_s: float) -> None:
+        """Record one stream-overlap window (trainer-side interval ledger):
+        ``decode_busy_s`` seconds of engine stepping, ``overlapped_s`` seconds
+        of reward/score/learn-stage work that ran inside those intervals."""
+        with self._lock:
+            self.stats.overlap_decode_s += float(decode_busy_s)
+            self.stats.overlap_overlapped_s += float(overlapped_s)
+            self.stats.overlap_windows += 1
+
     def summary(self) -> Dict[str, float]:
         # stats counters are written by step() under self._lock; snapshot them
         # under the same lock so a gauge read during a concurrent round is
@@ -951,6 +966,18 @@ class ServingEngine:
                 ),
                 "spec_rounds": float(self.stats.spec_rounds),
                 "chunk_appends": float(self.stats.chunk_appends),
+                # scored+learned time overlapped with decode ÷ decode time;
+                # can exceed 1.0 when several reward workers hide more than
+                # one serial second per decode second (unclamped on purpose)
+                "overlap_fraction": (
+                    self.stats.overlap_overlapped_s
+                    / max(1e-9, self.stats.overlap_decode_s)
+                    if self.stats.overlap_windows
+                    else 0.0
+                ),
+                "overlap_decode_s": float(self.stats.overlap_decode_s),
+                "overlap_overlapped_s": float(self.stats.overlap_overlapped_s),
+                "overlap_windows": float(self.stats.overlap_windows),
             }
         out["mean_slot_occupancy"] = self.scheduler.mean_slot_occupancy
         out["prefix_cache_hit_rate"] = self.allocator.stats.hit_rate
@@ -978,6 +1005,7 @@ class ServingEngine:
         gauges.set("serving/pending_depth", s["pending_depth"])
         gauges.set("serving/accepted_tok_per_round", s["accepted_tok_per_round"])
         gauges.set("serving/spec_accept_rate", s["spec_accept_rate"])
+        gauges.set("serving/overlap_fraction", s["overlap_fraction"])
         gauges.set("serving/shed", s["shed"])
         gauges.set("serving/expired", s["expired"])
         gauges.set("serving/preempted", s["preempted"])

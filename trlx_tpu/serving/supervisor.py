@@ -146,6 +146,9 @@ class ServingSupervisor:
             engine = self._engine
         engine.set_params(params)
 
+    def note_overlap(self, decode_busy_s: float, overlapped_s: float) -> None:
+        self.engine.note_overlap(decode_busy_s, overlapped_s)
+
     def summary(self) -> Dict[str, float]:
         out = self.engine.summary()
         with self._lock:

@@ -670,6 +670,48 @@ class MeshRLTrainer(BaseRLTrainer):
         """One optimizer step on a host batch; returns flat stats."""
         ...
 
+    # ----------------------------------------------------- staged learn batches
+    # The microbatch-interleaved learn seam for stream-overlapped PPO
+    # (docs/serving.md "Stream-overlapped PPO"): during the streaming window
+    # the experience producer collates upcoming first-epoch learner batches
+    # and ``device_put``s them while decode still owns the wall-clock, then
+    # the train loop consumes the pre-staged device copies instead of
+    # re-transferring. Purely a transfer optimization — the staged host batch
+    # must match the loader's batch exactly or the whole stage is discarded,
+    # so the optimizer sees identical data either way.
+
+    def _clear_staged_learn(self) -> None:
+        self._staged_learn: List[Tuple[Any, Any]] = []
+
+    def _stage_learn_batch(self, host_batch, device_batch) -> None:
+        """Record a (host, device) learn-batch pair staged ahead of the loop."""
+        if not hasattr(self, "_staged_learn"):
+            self._clear_staged_learn()
+        self._staged_learn.append((host_batch, device_batch))
+
+    @staticmethod
+    def _host_batches_equal(a, b) -> bool:
+        flat_a, tree_a = jax.tree.flatten(a)
+        flat_b, tree_b = jax.tree.flatten(b)
+        if tree_a != tree_b:
+            return False
+        return all(np.array_equal(x, y) for x, y in zip(flat_a, flat_b))
+
+    def _pop_staged_learn(self, batch):
+        """Device copy staged for ``batch``, or None to fall back to a fresh
+        transfer. Staged batches are predictions of the loader's output in
+        order; the first mismatch (quarantine drop, truncation, reshuffle)
+        invalidates the remainder — correctness never depends on staging."""
+        staged = getattr(self, "_staged_learn", None)
+        if not staged:
+            return None
+        host, dev = staged[0]
+        if self._host_batches_equal(host, batch):
+            staged.pop(0)
+            return dev
+        self._clear_staged_learn()
+        return None
+
     def prepare_learning(self):
         pass
 

@@ -40,6 +40,27 @@ from trlx_tpu.utils.modeling import RunningMoments, flatten_dict, logprobs_of_la
 
 logger = logging.get_logger(__name__)
 
+#: Max distinct response-length buckets the streaming path may compile per
+#: (B, P) score-fn family — the recompile bound docs/serving.md documents.
+_STREAM_MAX_R_BUCKETS = 4
+
+
+def check_stream_bucket_family(families, B: int, P: int, R: int, limit: int = _STREAM_MAX_R_BUCKETS):
+    """Record R under the (B, P) family and assert the family stays bounded.
+
+    Varied completion lengths must quantize onto a fixed small ladder of
+    padded shapes (``_overlap_r_buckets``); a shape escaping the ladder means
+    unbounded jit recompiles, which this turns into a loud failure instead of
+    a silent compile storm."""
+    fam = families.setdefault((B, P), set())
+    fam.add(R)
+    if len(fam) > limit:
+        raise AssertionError(
+            f"streaming score-fn bucket family (B={B}, P={P}) grew to "
+            f"{sorted(fam)}; the response-length quantizer must keep "
+            f"<= {limit} shapes per family"
+        )
+
 
 @register_trainer
 class PPOTrainer(MeshRLTrainer):
@@ -55,6 +76,9 @@ class PPOTrainer(MeshRLTrainer):
         self.mean_kl = 0.0
         self.rollout_stats: Dict[str, float] = {}
         self._score_fns = {}
+        # (B, P) -> set of R shapes compiled through the streaming path; the
+        # quantizer in _overlap_r_buckets must keep each family bounded
+        self._score_fn_families = {}
         self._train_steps = {}
 
         # async rollout engine state (trlx_tpu/rollout; resolved in
@@ -317,8 +341,25 @@ class PPOTrainer(MeshRLTrainer):
                 self._ref_dev = jax.device_put(self._ref_host, self._ref_shardings)
         return self._ref_dev
 
+    def _pin_ref(self):
+        """Pin the device ref view for a whole streaming window: materialize it
+        once up front and make :meth:`_release_ref` a no-op until
+        :meth:`_unpin_ref`. Without the pin, any release inside the window
+        would force per-bucket host→device re-uploads of the full reference
+        tree — exactly the transfer the streaming path exists to hide."""
+        self._ref_pinned = True
+        if getattr(self, "_ref_host", None) is not None:
+            self._ref_scoring_params()
+
+    def _unpin_ref(self):
+        """End of the streaming window (stream drain): allow release again."""
+        self._ref_pinned = False
+
     def _release_ref(self):
-        """Free the device ref copy after make_experience (no-op unless offloaded)."""
+        """Free the device ref copy after make_experience (no-op unless
+        offloaded; deferred while a streaming window holds the pin)."""
+        if getattr(self, "_ref_pinned", False):
+            return
         self._ref_dev = None
 
     def trainable_path_predicate(self, path: str) -> bool:
@@ -407,9 +448,16 @@ class PPOTrainer(MeshRLTrainer):
 
             f.write(json.dumps(config.to_dict(), indent=2))
 
-    def _get_score_fn(self, B: int, P: int, R: int):
+    def _get_score_fn(self, B: int, P: int, R: int, bounded_family: bool = False):
         """Jitted scoring pass: policy logprobs+values and reference logprobs over
-        the response window (parity: :414-446). One compile per (B, P, R)."""
+        the response window (parity: :414-446). One compile per (B, P, R).
+
+        ``bounded_family`` marks a streaming-microbucket caller: R is then
+        asserted to stay within the ≤4-shape quantized ladder per (B, P)
+        family, so varied completion lengths cannot trigger unbounded
+        recompiles."""
+        if bounded_family:
+            check_stream_bucket_family(self._score_fn_families, B, P, R)
         key = (B, P, R)
         if key in self._score_fns:
             return self._score_fns[key]
@@ -627,6 +675,306 @@ class PPOTrainer(MeshRLTrainer):
         with self.obs.span("generate"):
             return self._serving_client.generate_batch(prompts, self._serving_max_new)
 
+    # --------------------------------------------------- stream-overlapped PPO
+
+    def _overlap_r_buckets(self) -> List[int]:
+        """The quantized response-length ladder for streaming microbuckets:
+        ≤ :data:`_STREAM_MAX_R_BUCKETS` pow2 shapes covering up to
+        ``max_new_tokens + 1`` (decode may re-append eos)."""
+        pow2 = [2 ** i for i in range(3, 14)]
+        from trlx_tpu.ops.generation import pad_to_bucket
+
+        top = max(1, self._serving_max_new + 1)
+        # ceil(top / d) for d in 8,4,2,1 — dedup after pow2 padding keeps the
+        # ladder at <= 4 entries with the full shape always present
+        return sorted({pad_to_bucket(max(1, -(-top // d)), pow2) for d in (8, 4, 2, 1)})
+
+    def _make_experience_streamed(
+        self, num_rollouts, iter_count, ppo_rl_elements, accumulated_kl, all_scores_log
+    ):
+        """Streaming experience pipeline (``train.serving.stream_overlap``;
+        docs/serving.md "Stream-overlapped PPO").
+
+        As each sequence finishes in the engine, its reward_fn call is
+        dispatched from a bounded worker pool; completed-and-scored sequences
+        are batched — in engine completion order, which is deterministic under
+        greedy decode — into fixed-shape microbuckets for the jitted score fn;
+        and first-epoch learner microbatches are collated and ``device_put``
+        while the tail of the batch is still decoding. The scoring dispatch is
+        double-buffered: bucket k's results are harvested only when bucket
+        k+1 is about to dispatch (or at drain), so the next bucket's
+        host→device transfer overlaps the in-flight device compute.
+
+        Rollout contents (query/response tensors, store order) are identical
+        to the serial serving path; score normalization runs per microbucket
+        instead of per chunk, so running-moment grouping legitimately differs.
+        ``TRLX_OVERLAP_SEED_REGRESSION=serialize`` forces serial in-memory
+        consumption (block on every reward before the next decode round) —
+        the seeded regression the overlap-fraction CI gate must catch."""
+        import copy
+        import random as pyrandom
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        from trlx_tpu.obs.overlap import OverlapWindow
+        from trlx_tpu.ops.generation import left_pad_batch, pad_to_bucket
+        from trlx_tpu.pipeline.ppo_pipeline import ppo_collate_fn
+        from trlx_tpu.resilience.chaos import chaos
+        from trlx_tpu.rollout.reorder import ReorderBuffer
+
+        cfg = self.config.train.serving
+        serialize = os.environ.get("TRLX_OVERLAP_SEED_REGRESSION", "") == "serialize"
+        mb = int(cfg.overlap_microbucket or self.method.chunk_size)
+        pad_id = self.tokenizer.pad_token_id
+        pow2 = [2 ** i for i in range(3, 14)]
+        r_ladder = self._overlap_r_buckets()
+        # the reward worker threads must not share the main thread's HF fast
+        # tokenizer (not re-entrant — same reasoning as overlap_reward_scoring)
+        if not hasattr(self, "_reward_tokenizer"):
+            self._reward_tokenizer = copy.deepcopy(self.tokenizer)
+
+        window = OverlapWindow()
+        reorder = ReorderBuffer()
+        pending = deque()  # (gidx, future, prompt, out_ids) in completion order
+        ready = deque()  # reward resolved, waiting for a full microbucket
+        inflight = [None]  # one dispatched-but-unharvested scoring bucket
+        dropped = [False]  # quarantine broke the 1:1 index map → stop staging
+        cur = {"P": 0}  # current prompt batch's shared prompt bucket
+        stage = {"perm": None, "next": 0}
+
+        def stream_reward(kw):
+            # chaos site "producer-wedge" in the streamed path: this reward
+            # RPC stalls briefly (a stuck scorer the bounded pool rides out —
+            # exactly-once accounting must hold regardless)
+            if chaos.should_fail("producer-wedge"):
+                logger.warning("chaos: streamed reward wedged at site 'producer-wedge'")
+                time.sleep(0.2)
+            t0 = time.perf_counter()
+            with span("reward"):
+                out = self.reward_fn(**kw)
+            window.note_work(t0, time.perf_counter())
+            return out
+
+        def r_bucket(r):
+            for cand in r_ladder:
+                if r <= cand:
+                    return cand
+            return pad_to_bucket(r, pow2)  # defensive; the ladder covers max_new+1
+
+        def dispatch(items):
+            # harvest bucket k-1 first: its device compute had a full bucket's
+            # worth of decode/reward time to finish, so the get is cheap, and
+            # the put_batch below then overlaps whatever is still in flight
+            harvest()
+            t0 = time.perf_counter()
+            n_real = len(items)
+            raw = [it[3] for it in items]
+            dense = np.ndim(raw[0]) > 0
+            if dense:
+                dense_scores = [np.asarray(s, np.float32) for s in raw]
+                scores = np.asarray([s.sum() for s in dense_scores], np.float32)
+            else:
+                dense_scores = None
+                scores = np.asarray(jax.device_get(raw), np.float32).reshape(-1)
+            all_scores_log.extend(scores.tolist())
+            # normalization runs per microbucket in completion order — the
+            # documented stats difference vs the serial per-chunk grouping
+            self.running_moments.update(scores)
+            if self.method.cliprange_reward:
+                scores = np.clip(
+                    scores, -self.method.cliprange_reward, self.method.cliprange_reward
+                )
+            if self.method.scale_reward == "running":
+                scores = scores / max(self.running_moments.std, 1e-8)
+            elif self.method.scale_reward == "ref":
+                scores = scores / max(self.method.ref_std or 1.0, 1e-8)
+
+            padded = list(items) + [items[-1]] * (mb - n_real)
+            prompts_b = [it[1] for it in padded]
+            outs_b = [it[2] for it in padded]
+            R = r_bucket(max(len(o) for o in outs_b))
+            q_ids, q_mask = left_pad_batch(prompts_b, pad_id, cur["P"])
+            r_ids = np.full((mb, R), pad_id, np.int32)
+            r_mask = np.zeros((mb, R), np.int32)
+            for j, o in enumerate(outs_b):
+                r_ids[j, : len(o)] = o
+                r_mask[j, : len(o)] = 1
+            score_fn = self._get_score_fn(mb, cur["P"], R, bounded_family=True)
+            # unlike the serial span, no device_get here: the forward is left
+            # in flight (async dispatch) and harvested at the next bucket
+            # boundary — that asynchrony IS the decode/score overlap
+            with span("score"):
+                seq = np.concatenate([q_ids, r_ids], axis=1)
+                smask = np.concatenate([q_mask, r_mask], axis=1)
+                dbatch = mesh_lib.put_batch(self.mesh, {"seq": seq, "mask": smask})
+                with self.mesh:
+                    logprobs, values, ref_logprobs = score_fn(
+                        self.params, self._ref_scoring_params(), self.frozen_branch_params,
+                        dbatch["seq"], dbatch["mask"],
+                    )
+            window.note_work(t0, time.perf_counter())
+            inflight[0] = (items, scores, dense_scores, r_mask, logprobs, values, ref_logprobs)
+            if serialize:
+                harvest()
+
+        def harvest():
+            if inflight[0] is None:
+                return
+            items, scores, dense_scores, rm_b, lp, v, rlp = inflight[0]
+            inflight[0] = None
+            t0 = time.perf_counter()
+            n_real = len(items)
+            lp = np.asarray(jax.device_get(lp))[:n_real]
+            v = np.asarray(jax.device_get(v))[:n_real]
+            rlp = np.asarray(jax.device_get(rlp))[:n_real]
+            rm = rm_b[:n_real]
+            # per-token KL penalty & reward assembly — the same k3 math as
+            # _score_and_store, per microbucket
+            log_ratio = (lp - rlp) * rm
+            kl_per_token = np.exp(log_ratio) - 1.0 - log_ratio
+            accumulated_kl.append(kl_per_token.sum(axis=1).mean())
+            kl_coef = self.kl_ctl.value
+            new_elements = []
+            for j in range(n_real):
+                _, prompt, out, _ = items[j]
+                l = int(rm[j].sum())
+                rewards = -kl_coef * log_ratio[j, :l]
+                if dense_scores is not None:
+                    ds = dense_scores[j]
+                    rewards[: min(l, len(ds))] += ds[: min(l, len(ds))]
+                else:
+                    rewards[l - 1] += scores[j]
+                new_elements.append(
+                    PPORLElement(
+                        query_tensor=np.asarray(prompt, np.int32),
+                        response_tensor=np.asarray(out, np.int32),
+                        logprobs=lp[j, :l],
+                        values=v[j, :l],
+                        rewards=rewards.astype(np.float32),
+                    )
+                )
+            # same trust boundary as _score_and_store; chaos replaces by
+            # position, so new_elements[j] still corresponds to items[j]
+            new_elements = chaos_corrupt_elements(new_elements)
+            kept = new_elements
+            if self._quarantine is not None:
+                kept = self._quarantine.filter(
+                    new_elements, context=f"iter={self.iter_count}"
+                )
+            kept_ids = {id(e) for e in kept}
+            for j, elem in enumerate(new_elements):
+                gidx = items[j][0]
+                if id(elem) in kept_ids:
+                    reorder.add(gidx, elem)
+                else:
+                    dropped[0] = True
+                    reorder.add(gidx, None)  # tombstone: never stall the cursor
+            ppo_rl_elements.extend(reorder.pop_ready())
+            maybe_stage_learn()
+            window.note_work(t0, time.perf_counter())
+
+        def maybe_stage_learn():
+            if not cfg.overlap_learn_stage or dropped[0]:
+                return
+            bs = self.config.train.batch_size
+            if stage["perm"] is None:
+                # replicate NumpyLoader's first-epoch permutation for the
+                # loader create_train_dataloader will build over the store
+                # (seed + iter_count, epoch 0); a mismatch at consume time is
+                # detected by content and falls back to a fresh transfer
+                idxs = list(range(num_rollouts))
+                pyrandom.Random(self.config.train.seed + iter_count).shuffle(idxs)
+                stage["perm"] = idxs
+            avail = min(len(ppo_rl_elements), num_rollouts)
+            while True:
+                start = stage["next"] * bs
+                if start + bs > num_rollouts:
+                    break
+                chunk = stage["perm"][start : start + bs]
+                if any(ix >= avail for ix in chunk):
+                    break
+                t0 = time.perf_counter()
+                with span("learn_stage"):
+                    host = ppo_collate_fn(pad_id, [ppo_rl_elements[ix] for ix in chunk])
+                    dev = mesh_lib.put_batch(self.mesh, host)
+                self._stage_learn_batch(host, dev)
+                window.note_work(t0, time.perf_counter())
+                stage["next"] += 1
+
+        def pump(block=False):
+            # move FIFO-completed rewards to ready: bucket composition follows
+            # engine completion order (deterministic), never worker timing
+            while pending:
+                gidx, fut, prompt, out = pending[0]
+                if not (block or fut.done()):
+                    break
+                pending.popleft()
+                ready.append((gidx, prompt, out, fut.result()[0]))
+            while len(ready) >= mb:
+                dispatch([ready.popleft() for _ in range(mb)])
+
+        gen_params = self.generation_params()
+        tparams = gen_params["transformer"]
+        if tparams is not self._serving_param_ref:
+            self._serving_engine.set_params(tparams)
+            self._serving_param_ref = tparams
+
+        self._pin_ref()
+        self._clear_staged_learn()
+        generated = 0
+        try:
+            with ThreadPoolExecutor(
+                max_workers=max(1, int(cfg.overlap_reward_workers)),
+                thread_name_prefix="overlap-reward",
+            ) as pool:
+                while generated < num_rollouts:
+                    batch = next(self.prompt_iterator)
+                    self._prompt_batches_drawn += 1
+                    prompts = batch["input_ids"]
+                    metadata = {k: v for k, v in batch.items() if k != "input_ids"}
+                    base = generated
+                    generated += len(prompts)
+                    cur["P"] = pad_to_bucket(
+                        max((len(p) for p in prompts), default=1), pow2
+                    )
+
+                    def on_finish(i, req, _base=base, _prompts=prompts, _meta=metadata):
+                        gidx = _base + i
+                        prompt = np.asarray(_prompts[i], np.int32)
+                        gen = np.asarray(req.generated, np.int32)
+                        row = np.concatenate([prompt, gen])[None, :]
+                        rmask = np.ones((1, len(gen)), np.int32)
+                        str_samples, str_prompts, str_outputs, out_ids = self.decode(
+                            [prompt], row, len(prompt), append_eos=True,
+                            response_masks=rmask,
+                        )
+                        kw = dict(
+                            samples=str_samples, prompts=str_prompts,
+                            outputs=str_outputs, tokenizer=self._reward_tokenizer,
+                            **{k: [v[i]] for k, v in _meta.items()},
+                        )
+                        fut = pool.submit(stream_reward, kw)
+                        pending.append((gidx, fut, prompt, out_ids[0]))
+                        if serialize:
+                            fut.result()  # seeded regression: serial consumption
+                        pump()
+
+                    with self.obs.span("decode"):
+                        self._serving_client.stream_batch(
+                            prompts, self._serving_max_new, on_finish,
+                            on_step=window.note_decode,
+                        )
+                    # drain before the next batch can change the prompt bucket
+                    pump(block=True)
+                    if ready:
+                        dispatch([ready.popleft() for _ in range(len(ready))])
+                    harvest()
+        finally:
+            self._unpin_ref()
+        eng = self._serving_engine
+        eng.note_overlap(window.decode_busy_s, window.overlapped_s)
+        eng.export_gauges()
+
     # ------------------------------------------------------------- experience
 
     def _generate_chunks(self, tokenizer, params=None):
@@ -671,7 +1019,23 @@ class PPOTrainer(MeshRLTrainer):
         self.clock.tick()
 
         overlap = self.method.overlap_reward_scoring
-        if overlap:
+        stream = (
+            self._serving_client is not None
+            and self.config.train.serving.stream_overlap
+            and jax.process_count() == 1
+        )
+        if self.config.train.serving.stream_overlap and self._serving_client is not None and not stream:
+            logger.warning(
+                "serving.stream_overlap is single-process only: "
+                "running the serial serving consumption path"
+            )
+        if stream:
+            # stream-overlapped PPO: reward/score/learn-stage while the tail
+            # of the batch is still decoding (docs/serving.md)
+            self._make_experience_streamed(
+                num_rollouts, iter_count, ppo_rl_elements, accumulated_kl, all_scores_log
+            )
+        elif overlap:
             import copy
             from collections import deque
             from concurrent.futures import ThreadPoolExecutor
@@ -1105,7 +1469,12 @@ class PPOTrainer(MeshRLTrainer):
             gauges.set("rollout/batch_staleness_max", float(stale.max()))
             if self._async_cfg.staleness_correction:
                 batch = batch.replace(staleness=stale)
-        dbatch = mesh_lib.put_batch(self.mesh, batch)
+        # stream-overlap learn seam: consume the device copy staged during the
+        # decode window when it matches this batch exactly; fresh transfer
+        # otherwise (identical data either way)
+        dbatch = self._pop_staged_learn(batch)
+        if dbatch is None:
+            dbatch = mesh_lib.put_batch(self.mesh, batch)
         step = self._get_train_step(
             batch.query_tensors.shape[0], batch.query_tensors.shape[1], batch.response_tensors.shape[1]
         )
