@@ -699,6 +699,117 @@ def _serving_overlap_perf(jax):
         mesh_lib.mesh_from_config = real_mesh_from_config
 
 
+def _island_perf(jax):
+    """Disaggregated-island leg (docs/parallelism.md "Islands"): with the
+    generation island driving real continuous-batching decode rounds and the
+    learner island publishing chunked weight broadcasts between fake
+    optimizer steps, how big is each island's idle bubble and how much of
+    the broadcast hid under decode?
+
+    A tiny char-LM serving engine runs saturated (slots kept full by the
+    driver thread, every round touching the island's gate and polling for
+    committed broadcasts) while a learner thread alternates a jitted
+    parameter-update step with a chunked publish through the shared round
+    gate. Keys:
+
+    - ``island_gen_idle_frac`` / ``island_learn_idle_frac``: the per-island
+      idle-bubble fractions from the interval ledgers (target < 0.1 on both;
+      the same measurement tests/test_islands.py gates under the seeded
+      blocking regression).
+    - ``island_broadcast_hidden_frac``: broadcast-chunk time that ran inside
+      decode-busy intervals / total broadcast time.
+    - ``island_version_lag_steps``: versions behind the publisher the engine
+      was at its last swap (1 = swapping every commit).
+    """
+    import threading
+
+    import jax.numpy as jnp
+
+    from trlx_tpu.models.presets import PRESETS
+    from trlx_tpu.models.transformer import TransformerLM
+    from trlx_tpu.rollout import ChunkedParameterPublisher
+    from trlx_tpu.serving import GenerationIsland, ServingEngine
+
+    config = PRESETS["gpt2"].replace(
+        vocab_size=37, hidden_size=32, num_layers=4, num_heads=2,
+        max_position_embeddings=64, compute_dtype=jnp.float32,
+    )
+    model = TransformerLM(config)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32), jnp.ones((1, 4), jnp.int32)
+    )["params"]
+    engine = ServingEngine(
+        model, params, num_slots=4, max_seq_len=32, block_size=4,
+        eos_token_id=None, pad_token_id=0, gen_kwargs=dict(do_sample=False), seed=0,
+    )
+    island = GenerationIsland(engine)
+    publisher = ChunkedParameterPublisher(
+        chunk_layers=2, chunk_pause_s=0.002, round_gate=island.round_gate
+    )
+    island.bind_publisher(publisher)
+    publisher.publish(params)
+
+    fake_update = jax.jit(lambda t: jax.tree.map(lambda x: x * 0.999, t))
+
+    def drain_finished():
+        for uid, _req in engine.scheduler.pop_finished().items():
+            engine.scheduler.pop_request(uid)
+            live.discard(uid)
+
+    # warmup: compile prefill buckets, the decode step, and the update step
+    live = set()
+    for p in ([5, 9, 11], [2, 30, 7, 1], [1, 2]):
+        live.add(engine.submit(p, 8))
+    while engine.scheduler.has_work:
+        engine.step()
+        drain_finished()
+    jax.block_until_ready(fake_update(params))
+
+    stop = threading.Event()
+
+    def decode_driver():
+        i = 0
+        while not stop.is_set():
+            while len(live) < 4:
+                live.add(engine.submit([3 + (i % 29), 7, 11], 8))
+                i += 1
+            engine.step()
+            drain_finished()
+
+    def learner_loop():
+        nonlocal params
+        while not stop.is_set():
+            t0 = time.monotonic()
+            params = jax.block_until_ready(fake_update(params))
+            island.note_learn(t0, time.monotonic())
+            t1 = time.monotonic()
+            publisher.publish(params)
+            island.note_learn(t1, time.monotonic())
+
+    island.open_window()
+    threads = [
+        threading.Thread(target=decode_driver, daemon=True),
+        threading.Thread(target=learner_loop, daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(1.2)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    summary = island.summary()
+    bytes_s = publisher.stats()["last_bytes_s"]
+    island.close()
+    return {
+        "island_gen_idle_frac": round(summary["gen_idle_frac"], 4),
+        "island_learn_idle_frac": round(summary["learn_idle_frac"], 4),
+        "island_broadcast_hidden_frac": round(summary["broadcast_hidden_frac"], 4),
+        "island_version_lag_steps": round(summary["version_lag"], 1),
+        "island_swaps": int(summary["swaps"]),
+        "island_broadcast_bytes_s": round(bytes_s, 1),
+    }
+
+
 def _big_perf(jax):
     """gpt2-xl-shaped (~1.56B param) single-chip leg: rollout decode + PPO train
     step with the memory machinery on — bf16 params, scan_layers, selective
@@ -974,14 +1085,19 @@ def measure():
     """Run the measurement on whatever platform the environment provides."""
     import jax
 
+    platform = jax.default_backend()
+
     # persistent compile cache (same env contract as mesh_trainer): on the
-    # tunneled TPU a cached program skips the flaky remote-compile helper
+    # tunneled TPU a cached program skips the flaky remote-compile helper.
+    # With no env override, accelerator runs still get a repo-local cache by
+    # default — the xl leg's minutes-long gpt2-xl compiles must not be paid
+    # again on every resumed measurement round
     cache_dir = os.environ.get("TRLX_COMPILE_CACHE")
+    if not cache_dir and platform != "cpu":
+        cache_dir = os.path.join(REPO_ROOT, ".bench_compile_cache")
     if cache_dir:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-
-    platform = jax.default_backend()
     legs = _LegLedger(platform)
 
     result = legs.run("primary", lambda: _primary_perf(jax))
@@ -1005,6 +1121,10 @@ def measure():
         result.update(legs.run("serving_overlap", lambda: _serving_overlap_perf(jax)))
     except Exception as e:
         result["serving_overlap_perf_error"] = f"{type(e).__name__}: {e}"[:300]
+    try:
+        result.update(legs.run("island", lambda: _island_perf(jax)))
+    except Exception as e:
+        result["island_perf_error"] = f"{type(e).__name__}: {e}"[:300]
     result.update(legs.run("ir_audit", _ir_audit_probe))
     if platform != "cpu":
         try:

@@ -200,6 +200,36 @@ if JAX_PLATFORMS=cpu TRLX_OVERLAP_SEED_REGRESSION=serialize timeout -k 10 600 \
 fi
 echo "seeded serialize correctly rejected"
 
+echo "== island tests (CPU)"
+# disaggregated islands: chunked-broadcast parity with the monolithic
+# publisher, torn-version impossibility under concurrent readers,
+# mid-broadcast crash + supervised-restart recovery, round-boundary atomic
+# swaps (one prefix-cache flush per version), mesh carving
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_islands.py -q -m "not slow" -p no:cacheprovider
+
+echo "== island idle-bubble proof (CPU)"
+# the acceptance scenario by name: with chunked broadcasts interleaving at
+# round boundaries, the generation island's measured idle-bubble fraction
+# stays < 0.1 and weight shipping hides under decode (live measurement)
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_islands.py -q -k "idle_bubble_proof" \
+    -p no:cacheprovider
+
+echo "== island seeded-blocking gate (blocking broadcast must stall decode)"
+# the island gate proves itself like the conc/IR/spec/overlap gates: force
+# the publisher to squat on the round gate for entire broadcasts
+# (TRLX_ISLAND_SEED_REGRESSION=blocking_broadcast) and require the
+# idle-bubble proof to FAIL — a broadcast that quietly serializes decode
+# must not report a hidden bubble
+if JAX_PLATFORMS=cpu TRLX_ISLAND_SEED_REGRESSION=blocking_broadcast timeout -k 10 600 \
+    python -m pytest tests/test_islands.py -q -k "idle_bubble_proof" \
+    -p no:cacheprovider > /dev/null 2>&1; then
+    echo "FATAL: seeded blocking_broadcast regression was NOT caught by the idle-bubble gate" >&2
+    exit 1
+fi
+echo "seeded blocking_broadcast correctly rejected"
+
 echo "== chaos soak smoke (CPU)"
 # the acceptance scenario by name: producer crashes + nan-loss + bad elements
 # + reward faults in one run, every recovery visible in gauges/summary

@@ -1,0 +1,532 @@
+"""Disaggregated-island tests: chunked broadcast parity with the monolithic
+publisher, the torn-version impossibility, mid-broadcast crash + recovery,
+mesh island carving, round-boundary atomic swaps on a real tiny engine,
+trainer wiring (`train.islands` off by default = monolithic publisher), and
+the measured idle-bubble proof that the CI seeded regression
+(``TRLX_ISLAND_SEED_REGRESSION=blocking_broadcast``) must break."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.obs.islands import IslandLedger
+from trlx_tpu.parallel.mesh import carve_islands, island_meshes
+from trlx_tpu.resilience.chaos import ChaosInjectedError, chaos
+from trlx_tpu.rollout import ChunkedParameterPublisher, ParameterPublisher, layer_chunks
+from trlx_tpu.serving import GenerationIsland
+from trlx_tpu.utils.metrics import gauges
+
+pytestmark = pytest.mark.islands
+
+
+def _tree(fill: float, layers: int = 4) -> dict:
+    out = {"wte": np.full((8, 4), fill, np.float32)}
+    for i in range(layers):
+        out[f"h_{i}"] = {
+            "w": np.full((4, 4), fill, np.float32),
+            "b": np.full((4,), fill, np.float32),
+        }
+    out["ln_f"] = np.full((4,), fill, np.float32)
+    return out
+
+
+def _leaves_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+# ------------------------------------------------------------ chunk splitting
+
+
+def test_layer_chunks_grouping_and_names():
+    tree = _tree(1.0, layers=4)  # wte, h_0..h_3, ln_f = 6 top-level keys
+    chunks = layer_chunks(tree, chunk_layers=1)
+    assert [n for n, _ in chunks] == ["wte", "h_0", "h_1", "h_2", "h_3", "ln_f"]
+    grouped = layer_chunks(tree, chunk_layers=4)
+    assert [n for n, _ in grouped] == ["wte..h_2", "h_3..ln_f"]
+    # reassembly by key is exact regardless of grouping
+    for split in (chunks, grouped):
+        rebuilt = {}
+        for _, sub in split:
+            rebuilt.update(sub)
+        assert _leaves_equal(rebuilt, tree)
+    # non-dict trees broadcast as one chunk
+    assert layer_chunks(np.ones(3))[0][0] == "all"
+    assert layer_chunks([np.ones(3)], chunk_layers=2)[0][0] == "all"
+
+
+# ------------------------------------------------------- parity + atomicity
+
+
+def test_chunked_publish_bit_identical_to_monolithic():
+    """Chunked broadcast must commit exactly the tree a monolithic publish
+    commits — same leaves, same values, byte-for-byte."""
+    tree = _tree(3.25)
+    mono = ParameterPublisher()
+    chunked = ChunkedParameterPublisher(chunk_layers=2)
+    v_m = mono.publish(tree)
+    v_c = chunked.publish(tree)
+    assert v_m == v_c == 0
+    _, snap_m = mono.latest()
+    _, snap_c = chunked.latest()
+    assert _leaves_equal(snap_m, snap_c)
+    assert _leaves_equal(snap_c, tree)
+    m = chunked.manifest()
+    assert m.version == 0 and m.num_chunks == len(layer_chunks(tree, 2))
+    assert m.total_bytes == sum(
+        np.asarray(x).nbytes for x in jax.tree.leaves(tree)
+    )
+
+
+def test_latest_raises_before_first_commit():
+    pub = ChunkedParameterPublisher()
+    with pytest.raises(RuntimeError, match="before first commit"):
+        pub.latest()
+    assert pub.poll_update(-1) is None
+    assert pub.version == -1 and pub.manifest() is None
+
+
+def test_no_torn_version_under_concurrent_reads():
+    """A reader hammering latest()/poll_update() while the publisher streams
+    chunks must only ever observe internally-consistent snapshots: every leaf
+    of version v carries v's sentinel fill value."""
+    pub = ChunkedParameterPublisher(chunk_layers=1, chunk_pause_s=0.002)
+    pub.publish(_tree(0.0))
+    stop = threading.Event()
+    torn = []
+
+    def reader():
+        last = -1
+        while not stop.is_set():
+            version, snap = pub.latest()
+            vals = {float(np.asarray(x).ravel()[0]) for x in jax.tree.leaves(snap)}
+            if vals != {float(version)}:
+                torn.append((version, vals))
+            upd = pub.poll_update(last)
+            if upd is not None:
+                last = upd[0]
+
+    threads = [threading.Thread(target=reader, daemon=True) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for v in range(1, 6):
+        pub.publish(_tree(float(v)))
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not torn, f"torn versions observed: {torn[:3]}"
+    assert pub.version == 5
+
+
+def test_midbroadcast_crash_burns_version_and_recovers():
+    """A publisher dying mid-broadcast leaves the previous committed version
+    untouched, burns the in-flight version number (monotonicity), counts the
+    abort, and a re-publish recovers cleanly."""
+    pub = ChunkedParameterPublisher(chunk_layers=1)
+    v0 = pub.publish(_tree(1.0))
+    chaos.configure("broadcast-chunk:1")
+    try:
+        with pytest.raises(ChaosInjectedError, match="broadcast-chunk"):
+            pub.publish(_tree(2.0))
+    finally:
+        chaos.configure("")
+    # the committed snapshot is still v0, bit-identical
+    version, snap = pub.latest()
+    assert version == v0
+    assert _leaves_equal(snap, _tree(1.0))
+    assert pub.stats()["aborted"] == 1
+    # the burned number is skipped, never reused
+    v2 = pub.publish(_tree(3.0))
+    assert v2 == v0 + 2
+    assert _leaves_equal(pub.latest()[1], _tree(3.0))
+
+
+def test_seed_regression_env_validation(monkeypatch):
+    monkeypatch.setenv("TRLX_ISLAND_SEED_REGRESSION", "typo_mode")
+    with pytest.raises(ValueError, match="TRLX_ISLAND_SEED_REGRESSION"):
+        ChunkedParameterPublisher()
+    monkeypatch.setenv("TRLX_ISLAND_SEED_REGRESSION", "blocking_broadcast")
+    assert ChunkedParameterPublisher()._blocking is True
+
+
+def test_blocking_broadcast_holds_round_gate(monkeypatch):
+    """Seeded regression mode must squat on the round gate for the whole
+    broadcast; normal mode must release it between chunks. Measured as how
+    long a mid-broadcast gate acquire (a decode round's boundary touch)
+    blocks: microseconds normally, until broadcast-end under the seed."""
+    gate = threading.Lock()
+
+    def gate_wait_mid_broadcast(pub) -> float:
+        # 10 chunks x 5ms pauses ~= a 45ms broadcast; probe at the 10ms mark
+        t = threading.Thread(
+            target=lambda: pub.publish(_tree(1.0, layers=8)), daemon=True
+        )
+        t.start()
+        time.sleep(0.01)
+        t0 = time.monotonic()
+        gate.acquire()
+        waited = time.monotonic() - t0
+        gate.release()
+        t.join(timeout=5)
+        return waited
+
+    normal = ChunkedParameterPublisher(round_gate=gate, chunk_pause_s=0.005)
+    assert gate_wait_mid_broadcast(normal) < 0.015, (
+        "normal mode must release the gate between chunks"
+    )
+
+    monkeypatch.setenv("TRLX_ISLAND_SEED_REGRESSION", "blocking_broadcast")
+    blocking = ChunkedParameterPublisher(round_gate=gate, chunk_pause_s=0.005)
+    assert gate_wait_mid_broadcast(blocking) > 0.015, (
+        "blocking_broadcast must hold the gate for the entire broadcast"
+    )
+
+
+# ------------------------------------------------------------- mesh carving
+
+
+def test_carve_islands_placement():
+    devices = list(range(8))
+    p = carve_islands(2, devices=devices)
+    assert p.gen == (6, 7) and p.learn == tuple(range(6)) and not p.shared
+    assert set(p.gen).isdisjoint(p.learn)
+    # single device degrades to thread-level islands on a shared device
+    p1 = carve_islands(1, devices=[0])
+    assert p1.shared and p1.gen == p1.learn == (0,)
+    with pytest.raises(ValueError):
+        carve_islands(0, devices=devices)
+    with pytest.raises(ValueError):
+        carve_islands(8, devices=devices)
+
+
+def test_island_meshes_are_disjoint(mesh8):
+    del mesh8  # ensures the 8-device platform is up
+    p = carve_islands(2, devices=jax.devices())
+    gen_mesh, learn_mesh = island_meshes(p, data=2, fsdp=3, model=1)
+    gen_ids = {d.id for d in gen_mesh.devices.flat}
+    learn_ids = {d.id for d in learn_mesh.devices.flat}
+    assert gen_ids.isdisjoint(learn_ids)
+    assert len(gen_ids) == 2 and len(learn_ids) == 6
+
+
+# ------------------------------------------------------------ island ledger
+
+
+def test_island_ledger_merges_and_windows():
+    led = IslandLedger("gen")
+    assert led.idle_fraction(until=1.0) == 0.0  # no window yet
+    led.open_window(10.0)
+    led.note_busy(10.0, 10.4)
+    led.note_busy(10.4002, 10.6)  # within merge eps: bridged
+    led.note_busy(10.8, 11.0)  # genuine 0.2s stall before it
+    assert led.busy_s(until=11.0) == pytest.approx(0.8, abs=1e-6)
+    assert led.idle_fraction(until=11.0) == pytest.approx(0.2, abs=1e-3)
+    snap = led.snapshot(until=11.0)
+    assert snap["gen_wall_s"] == pytest.approx(1.0)
+    # out-of-window work is clipped, pre-window dropped on reopen
+    led.open_window(20.0)
+    led.note_busy(19.0, 20.5)
+    assert led.busy_s(until=21.0) == pytest.approx(0.5, abs=1e-6)
+
+
+# -------------------------------------------- engine round-boundary swapping
+
+
+TINY = dict(
+    vocab_size=37, hidden_size=16, num_layers=2, num_heads=2,
+    max_position_embeddings=64, compute_dtype=jnp.float32,
+)
+
+
+def _tiny_engine():
+    from trlx_tpu.models.presets import PRESETS
+    from trlx_tpu.models.transformer import TransformerLM
+    from trlx_tpu.serving import ServingEngine
+
+    config = PRESETS["gpt2"].replace(**TINY)
+    model = TransformerLM(config)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32), jnp.ones((1, 4), jnp.int32)
+    )["params"]
+    engine = ServingEngine(
+        model, params, num_slots=3, max_seq_len=32, block_size=4,
+        eos_token_id=None, pad_token_id=0, gen_kwargs=dict(do_sample=False), seed=0,
+    )
+    return engine, params
+
+
+def test_engine_swaps_at_round_boundary_one_flush_per_version():
+    """With an island attached the engine installs each committed broadcast
+    exactly once, at a round boundary, with exactly one prefix-cache flush
+    per version — and serves requests correctly across the swap."""
+    engine, params = _tiny_engine()
+    island = GenerationIsland(engine)
+    pub = ChunkedParameterPublisher(chunk_layers=2)
+    island.bind_publisher(pub)
+    island.open_window()
+
+    flushes = []
+    real_flush = engine.allocator.flush_prefix_cache
+    engine.allocator.flush_prefix_cache = lambda: (flushes.append(1), real_flush())[1]
+
+    assert engine.serving_version == -1
+    pub.publish(params)
+    uid = engine.submit([5, 9, 11], 4)
+    done = engine.run([uid])
+    assert len(done[uid].generated) == 4
+    assert engine.serving_version == 0
+    assert len(flushes) == 1  # one flush for v0, however many rounds ran
+
+    # a second publish swaps once more; extra rounds with no new version
+    # never re-flush
+    pub.publish(params)
+    uid2 = engine.submit([2, 3], 4)
+    engine.run([uid2])
+    assert engine.serving_version == 1
+    assert len(flushes) == 2
+    s = island.summary()
+    assert s["swaps"] == 2.0 and s["serving_version"] == 1.0
+    assert island.gen_ledger.busy_s() > 0.0
+    island.close()
+    assert gauges.snapshot("serving/island/") == {}
+    assert gauges.snapshot("rollout/broadcast/") == {}
+
+
+def test_supervised_restart_reattaches_island():
+    """A supervised engine restart must re-attach the island: the successor's
+    first round fresh-installs the newest committed version (swap cursor back
+    to -1, never a torn install)."""
+    from trlx_tpu.serving.supervisor import ServingSupervisor
+
+    engines = []
+
+    def factory():
+        engine, _ = _tiny_engine()
+        engines.append(engine)
+        return engine
+
+    sup = ServingSupervisor(factory, max_restarts=2, backoff_base_s=0.0,
+                            wedge_timeout_s=None)
+    island = GenerationIsland(sup)
+    pub = ChunkedParameterPublisher()
+    island.bind_publisher(pub)
+    _, params = _tiny_engine()
+    pub.publish(params)
+
+    uid = sup.submit([5, 9, 11], 4)
+    done = sup.run([uid])
+    assert len(done[uid].generated) == 4
+    assert sup.serving_version == 0
+
+    chaos.configure("serving-decode:1")
+    try:
+        uid2 = sup.submit([2, 3], 4)
+        done = sup.run([uid2])
+    finally:
+        chaos.configure("")
+    assert len(done[uid2].generated) == 4
+    assert sup.restarts == 1 and len(engines) == 2
+    # the successor re-polled and re-installed the committed version
+    assert engines[-1]._island is island
+    assert sup.serving_version == 0
+    sup.close()
+    island.close()
+
+
+# --------------------------------------------------------- idle-bubble proof
+
+
+def test_island_idle_bubble_proof():
+    """The measured tentpole claim: with chunked broadcasts interleaving at
+    round boundaries, the generation island's idle-bubble fraction stays
+    under 0.1 and the broadcast hides under decode. Under
+    ``TRLX_ISLAND_SEED_REGRESSION=blocking_broadcast`` the publisher squats
+    on the round gate for whole broadcasts, decode stalls behind it, and this
+    test MUST fail — that inversion is the CI gate (scripts/ci.sh)."""
+
+    class _FakeEngine:
+        def attach_island(self, island):
+            self._island = island
+
+        serving_version = -1
+
+    island = GenerationIsland(_FakeEngine())
+    pub = ChunkedParameterPublisher(
+        chunk_layers=1, chunk_pause_s=0.005, round_gate=island.round_gate
+    )
+    island.bind_publisher(pub)
+    stop = threading.Event()
+
+    def decode_loop():
+        # a free-running decode loop: every round touches the gate (exactly
+        # as ServingEngine.step does), then does ~2ms of "device work"
+        while not stop.is_set():
+            island.round_gate.acquire()
+            island.round_gate.release()
+            t0 = time.monotonic()
+            time.sleep(0.002)
+            island.note_round(t0, time.monotonic())
+
+    t = threading.Thread(target=decode_loop, daemon=True)
+    t.start()
+    time.sleep(0.05)  # let the loop reach steady state before measuring
+    island.open_window()
+    deadline = time.monotonic() + 0.6
+    version = 0
+    while time.monotonic() < deadline:
+        # 8-chunk broadcasts with 5ms pauses: each spans many decode rounds
+        t0 = time.monotonic()
+        version = pub.publish(_tree(float(version + 1), layers=6))
+        island.note_learn(t0, time.monotonic())
+        time.sleep(0.03)
+    stop.set()
+    t.join(timeout=5)
+    s = island.summary()
+    assert s["gen_idle_frac"] < 0.1, (
+        f"generation island idle-bubble fraction {s['gen_idle_frac']:.3f} "
+        f">= 0.1: broadcasts are not hiding under decode (summary: {s})"
+    )
+    assert s["broadcast_hidden_frac"] > 0.5, (
+        f"broadcast overlapped decode for only "
+        f"{s['broadcast_hidden_frac']:.2f} of its wall time (summary: {s})"
+    )
+    assert s["swaps"] == 0.0  # nobody polled: the fake engine has no step loop
+    island.close()
+
+
+# ------------------------------------------------------------ trainer wiring
+
+
+def test_islands_config_off_by_default():
+    from trlx_tpu.data.configs import IslandConfig, TRLConfig
+
+    assert IslandConfig().enabled is False
+    config = TRLConfig.from_dict(
+        {
+            "train": {
+                "seq_length": 8, "epochs": 1, "total_steps": 1, "batch_size": 2,
+                "checkpoint_interval": 1, "eval_interval": 1,
+                "pipeline": "PromptPipeline", "trainer": "PPOTrainer",
+                "islands": {"enabled": True, "gen_devices": 2,
+                            "chunk_layers": 4, "chunk_pause_s": 0.001},
+            },
+            "method": {"name": "PPOConfig", "num_rollouts": 2, "chunk_size": 2,
+                       "ppo_epochs": 1, "init_kl_coef": 0.01, "target": None,
+                       "gen_kwargs": {"max_new_tokens": 2}},
+            "model": {"model_path": "gpt2"},
+            "tokenizer": {"tokenizer_path": "char://ab"},
+            "optimizer": {"name": "adamw", "kwargs": {"lr": 1e-3}},
+            "scheduler": {"name": "cosine_annealing", "kwargs": {"T_max": 10}},
+        }
+    )
+    icfg = config.train.islands
+    assert icfg.enabled and icfg.gen_devices == 2
+    assert icfg.chunk_layers == 4 and icfg.chunk_pause_s == 0.001
+
+
+@pytest.fixture
+def single_device_mesh(monkeypatch):
+    from trlx_tpu.parallel import mesh as mesh_lib
+
+    real = mesh_lib.make_mesh
+    monkeypatch.setattr(
+        mesh_lib, "mesh_from_config",
+        lambda cfg, devices=None: real(
+            data=1, fsdp=1, model=1, devices=jax.devices()[:1]
+        ),
+    )
+
+
+def _islands_trainer(tmp_path, monkeypatch, islands=None, serving=None):
+    """A tiny PPO trainer with the async engine resolved but its producer
+    thread suppressed — enough to inspect exactly what _start_async_engine
+    wired up, without a live rollout loop."""
+    from tests.test_serving import _build_ppo, _tiny_ppo_config
+    from trlx_tpu.rollout.engine import AsyncRolloutEngine
+
+    config = _tiny_ppo_config(tmp_path, serving=serving)
+    config.train.async_rollouts.enabled = True
+    config.train.async_rollouts.max_staleness = 4
+    if islands is not None:
+        config.train.islands = islands
+    monkeypatch.setattr(AsyncRolloutEngine, "start", lambda self: None)
+    trainer = _build_ppo(config)
+    trainer._resolve_serving()
+    trainer._async_cfg = trainer._resolve_async_config()
+    assert trainer._async_cfg is not None
+    trainer._start_async_engine()
+    return trainer
+
+
+@pytest.mark.slow
+def test_trainer_islands_off_is_monolithic(tmp_path, monkeypatch, single_device_mesh):
+    """`train.islands` off (the default) must wire the exact pre-island
+    stack: a plain ParameterPublisher and no island anywhere."""
+    from trlx_tpu.data.configs import ServingConfig
+
+    trainer = _islands_trainer(
+        tmp_path, monkeypatch,
+        serving=ServingConfig(enabled=True, num_slots=3, block_size=4),
+    )
+    assert type(trainer._engine.publisher) is ParameterPublisher
+    assert trainer._island is None
+    assert trainer._serving_engine._island is None
+    trainer.on_learn_end()
+
+
+@pytest.mark.slow
+def test_trainer_islands_requires_serving(tmp_path, monkeypatch, single_device_mesh):
+    """islands.enabled without serving falls back (with a warning) to the
+    monolithic path instead of crashing."""
+    from trlx_tpu.data.configs import IslandConfig
+
+    trainer = _islands_trainer(
+        tmp_path, monkeypatch, islands=IslandConfig(enabled=True)
+    )
+    assert trainer._serving_client is None
+    assert type(trainer._engine.publisher) is ParameterPublisher
+    assert trainer._island is None
+    trainer.on_learn_end()
+
+
+@pytest.mark.slow
+def test_trainer_islands_wiring(tmp_path, monkeypatch, single_device_mesh):
+    """islands + serving wires the full split: chunked publisher sharing the
+    island's round gate, engine attached, seed version committed, and
+    on_learn_end clears every island/broadcast gauge."""
+    from trlx_tpu.data.configs import IslandConfig, ServingConfig
+
+    trainer = _islands_trainer(
+        tmp_path, monkeypatch,
+        islands=IslandConfig(enabled=True, chunk_layers=2),
+        serving=ServingConfig(enabled=True, num_slots=3, block_size=4),
+    )
+    island = trainer._island
+    assert island is not None
+    pub = trainer._engine.publisher
+    assert type(pub) is ChunkedParameterPublisher
+    assert pub._gate is island.round_gate
+    assert pub.chunk_layers == 2
+    assert trainer._serving_engine._island is island
+    assert pub.version == 0  # the seed publish committed
+    assert pub.manifest().num_chunks >= 1
+    # islands mode: _serving_generate must NOT install params behind the
+    # engine's back — the engine self-swaps at round boundaries
+    ref_before = trainer._serving_param_ref
+    seqs, mask, P = trainer._serving_generate([np.asarray([3, 4], np.int32)])
+    assert trainer._serving_param_ref is ref_before
+    assert seqs.shape[0] == 1 and mask.shape[0] == 1 and P >= 2
+    # the engine polled the publisher and installed v0 at a round boundary
+    assert trainer._serving_engine.serving_version == 0
+    assert trainer._serving_client.policy_version == 0
+    trainer.on_learn_end()
+    assert trainer._island is None
+    assert gauges.snapshot("serving/island/") == {}
+    assert gauges.snapshot("rollout/broadcast/") == {}

@@ -282,6 +282,43 @@ class AsyncRolloutConfig:
 
 
 @dataclass
+class IslandConfig:
+    """Sebulba-style disaggregated islands (``trlx_tpu/serving/island.py``,
+    ``trlx_tpu/rollout/broadcast.py``; docs/parallelism.md "Islands").
+
+    When enabled (requires ``serving.enabled`` and ``async_rollouts`` with
+    ``max_staleness > 0``), the serving engine runs as a *generation island*
+    and the PPO optimizer as a *learner island*: parameter publishes stream
+    layer-by-layer through a chunked broadcast while decode rounds continue,
+    the engine swaps to each committed version atomically at a round boundary
+    (one prefix-cache flush per version), and per-island idle-bubble ledgers
+    prove neither side waits on the other (``serving/island/*`` and
+    ``rollout/broadcast/*`` gauges). Off (the default) keeps the monolithic
+    publisher and the per-rollout ``set_params`` install byte-identical to
+    the single-island path.
+
+    :param enabled: master switch for the island split.
+    :param gen_devices: devices carved for the generation island
+        (``parallel/mesh.py:carve_islands``; with one device total the
+        islands are thread-level tenants of the same chip).
+    :param chunk_layers: top-level parameter-tree keys (for a transformer:
+        layers) per broadcast chunk. 1 ships strictly layer-by-layer.
+    :param chunk_pause_s: host-side yield between chunks — the knob that
+        spreads a broadcast across more decode rounds on hardware where the
+        copy itself is bandwidth-bound. 0 broadcasts back-to-back.
+    """
+
+    enabled: bool = False
+    gen_devices: int = 1
+    chunk_layers: int = 1
+    chunk_pause_s: float = 0.0
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        return cls(**config)
+
+
+@dataclass
 class ObservabilityConfig:
     """Unified observability layer (``trlx_tpu/obs``; docs/observability.md).
 
@@ -711,6 +748,11 @@ class TrainConfig:
     # experience queue and staleness-aware PPO) — see AsyncRolloutConfig.
     async_rollouts: "AsyncRolloutConfig" = field(default_factory=lambda: AsyncRolloutConfig())
 
+    # Sebulba islands (generation island on the serving engine + learner
+    # island, chunked decode-overlapped weight broadcast) — see IslandConfig
+    # and docs/parallelism.md "Islands".
+    islands: "IslandConfig" = field(default_factory=lambda: IslandConfig())
+
     # Observability layer (span tracing / throughput + MFU / memory gauges /
     # stall watchdog) — see ObservabilityConfig and docs/observability.md.
     observability: "ObservabilityConfig" = field(default_factory=lambda: ObservabilityConfig())
@@ -773,6 +815,9 @@ class TrainConfig:
         ar = config.get("async_rollouts")
         if isinstance(ar, dict):
             config["async_rollouts"] = AsyncRolloutConfig.from_dict(ar)
+        isl = config.get("islands")
+        if isinstance(isl, dict):
+            config["islands"] = IslandConfig.from_dict(isl)
         obs = config.get("observability")
         if isinstance(obs, dict):
             config["observability"] = ObservabilityConfig.from_dict(obs)

@@ -16,6 +16,7 @@ facade the trainer drives from ``TRLConfig.train.observability``:
   thread stacks when the learner or producer stops making progress.
 """
 
+from trlx_tpu.obs.islands import IslandLedger
 from trlx_tpu.obs.memory import device_memory_stats, host_rss_bytes
 from trlx_tpu.obs.overlap import OverlapWindow
 from trlx_tpu.obs.runtime import Observability, batch_token_count
@@ -30,6 +31,7 @@ from trlx_tpu.obs.throughput import (
 from trlx_tpu.obs.watchdog import StallWatchdog, format_all_stacks, watchdog
 
 __all__ = [
+    "IslandLedger",
     "Observability",
     "OverlapWindow",
     "PEAK_TFLOPS_BY_DEVICE_KIND",

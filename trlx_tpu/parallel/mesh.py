@@ -15,7 +15,7 @@ ICI — replacing every explicit NCCL call in the reference.
 """
 
 import os
-from typing import Optional, Sequence
+from typing import NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -140,6 +140,66 @@ def make_deviceless_mesh(
             f"--xla_force_host_platform_device_count={n} before jax imports"
         )
     return Mesh(np.array(devices).reshape(data, fsdp, pipe, model), MESH_AXES)
+
+
+class IslandPlacement(NamedTuple):
+    """Device carve for the Sebulba split (docs/parallelism.md "Islands"):
+    which devices host the generation island (serving engine) and which host
+    the learner island (PPO train step). ``shared`` marks the single-device
+    degenerate case where both islands are thread-level tenants of one chip."""
+
+    gen: Tuple
+    learn: Tuple
+    shared: bool
+
+
+def carve_islands(gen_devices: int = 1, devices: Optional[Sequence] = None) -> IslandPlacement:
+    """Carve the flat device set into disjoint generation and learner islands.
+
+    The generation island takes the *last* ``gen_devices`` devices and the
+    learner keeps the lowest-index prefix — so the learner mesh built from
+    the remainder lays out identically to a smaller single-island run, and
+    the generation devices sit at the far end of the ICI order where their
+    decode traffic does not cross the learner's collective paths. With a
+    single device both islands share it (thread-level islands, the CPU-test
+    and single-chip topology); with more, the carve is strictly disjoint.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    g = int(gen_devices)
+    if g < 1:
+        raise ValueError(f"gen_devices must be >= 1, got {g}")
+    if n == 1:
+        return IslandPlacement((devices[0],), (devices[0],), True)
+    if g >= n:
+        raise ValueError(
+            f"gen_devices={g} leaves no learner devices out of {n}: the carve "
+            f"needs at least one device per island"
+        )
+    return IslandPlacement(tuple(devices[n - g:]), tuple(devices[:n - g]), False)
+
+
+def island_meshes(
+    placement: IslandPlacement,
+    data: int = -1,
+    fsdp: int = 1,
+    model: int = 1,
+    pipe: int = 1,
+) -> Tuple[Mesh, Mesh]:
+    """Build ``(gen_mesh, learn_mesh)`` over a carve from :func:`carve_islands`.
+
+    The generation mesh is pure data-parallel over its devices (each replica
+    runs the single-device paged-decode step — the kernel is deliberately not
+    SPMD-partitioned, docs/parallelism.md); the learner mesh takes the
+    requested ``data × fsdp × pipe × model`` axes over the learner devices.
+    """
+    gen_mesh = make_mesh(
+        data=len(placement.gen), fsdp=1, model=1, pipe=1, devices=list(placement.gen)
+    )
+    learn_mesh = make_mesh(
+        data=data, fsdp=fsdp, model=model, pipe=pipe, devices=list(placement.learn)
+    )
+    return gen_mesh, learn_mesh
 
 
 def mesh_from_config(mesh_config, devices: Optional[Sequence] = None) -> Mesh:

@@ -39,7 +39,11 @@ of ``site:arg`` tokens:
   reported as allocation failures (exercises KV-pressure preemption);
 - ``serving-wedge:N`` — the serving engine's step loop wedges ``N`` times: it
   stops beating the watchdog and blocks until aborted (exercises the
-  watchdog-escalation / wedge-timer → supervised-restart path).
+  watchdog-escalation / wedge-timer → supervised-restart path);
+- ``broadcast-chunk:N`` — the next ``N`` chunked-broadcast layer installs
+  raise mid-broadcast (exercises the torn-version guarantee: the committed
+  snapshot must stay the previous version, the burned version number must
+  stay monotonic, and a re-publish must recover).
 
 Count-based sites are *budgets*: each injected fault decrements the budget, so
 ``reward:2`` means exactly two failures then clean behavior — which is exactly
@@ -75,6 +79,7 @@ _COUNT_SITES = (
     "serving-decode",
     "serving-alloc",
     "serving-wedge",
+    "broadcast-chunk",
 )
 
 

@@ -12,6 +12,10 @@ LlamaRL-style pipelined-rollout design; see docs/rollout.md):
 - :mod:`trlx_tpu.rollout.publisher` — versioned parameter snapshots (monotonic
   policy version; donate-free device copies) so the producer samples with
   version *v* while the learner optimizes toward *v+1*.
+- :mod:`trlx_tpu.rollout.broadcast` — chunked, decode-overlapped weight
+  broadcast for the island split: layer-by-layer staging under a round gate,
+  version-stamped manifests, atomic commit (``train.islands``;
+  docs/parallelism.md "Islands").
 - :mod:`trlx_tpu.rollout.staleness` — staleness accounting, the
   ``max_staleness`` admission cap, and the clipped per-token importance-weight
   correction applied inside the PPO loss.
@@ -26,6 +30,11 @@ Enabled via ``TrainConfig.async_rollouts``; the synchronous path stays the
 default and ``max_staleness=0`` falls back to it exactly.
 """
 
+from trlx_tpu.rollout.broadcast import (
+    BroadcastManifest,
+    ChunkedParameterPublisher,
+    layer_chunks,
+)
 from trlx_tpu.rollout.engine import AsyncRolloutEngine
 from trlx_tpu.rollout.publisher import ParameterPublisher
 from trlx_tpu.rollout.queue import ExperienceQueue, QueueClosed
@@ -35,6 +44,9 @@ from trlx_tpu.rollout.supervisor import ProducerRestartBudgetExceeded, ProducerS
 
 __all__ = [
     "AsyncRolloutEngine",
+    "BroadcastManifest",
+    "ChunkedParameterPublisher",
+    "layer_chunks",
     "ExperienceQueue",
     "ParameterPublisher",
     "ProducerRestartBudgetExceeded",

@@ -9,6 +9,12 @@ PPO trainer already uses for its frozen KL reference) and pairs it with a
 monotonic policy version: the producer generates with version *v* while the
 learner optimizes toward *v+1*, and every experience element is tagged with
 the version it was sampled from so staleness is observable downstream.
+
+Under ``train.islands`` the one-shot snapshot here is replaced by the
+drop-in :class:`~trlx_tpu.rollout.broadcast.ChunkedParameterPublisher`
+(same ``publish``/``latest``/``version`` surface), which streams the tree
+layer-by-layer under the generation island's round gate and commits each
+version atomically — docs/parallelism.md "Islands".
 """
 
 import threading

@@ -20,7 +20,11 @@ trainer's point of view (``publisher`` / ``start`` / ``collect`` /
   (``restart_backoff_base_s`` doubling up to ``restart_backoff_max_s``),
   build + start the next one. The new producer's first iteration reads
   ``publisher.latest()`` — that *is* the resync: it samples with the
-  freshest published policy, not the snapshot the dead producer held.
+  freshest published policy, not the snapshot the dead producer held. The
+  same contract covers the chunked island publisher
+  (:class:`~trlx_tpu.rollout.broadcast.ChunkedParameterPublisher`):
+  ``latest()`` only ever returns *committed* broadcasts, so a restart can
+  resync mid-broadcast without observing a torn version.
 - **Crash detection at the collect seam.** All recovery runs on the learner
   thread inside :meth:`collect`: the engine's own liveness checks (error
   recorded, thread dead without error) raise ``RuntimeError``, the

@@ -10,6 +10,9 @@ batching + prefix sharing). See docs/serving.md.
   (deadlines, load shedding, preemption; docs/serving.md "Fault tolerance")
 - :mod:`supervisor` — ServingSupervisor: supervised engine restarts with
   request replay under a bounded budget
+- :mod:`island` — GenerationIsland: round gate, atomic broadcast-version
+  swaps, per-island idle-bubble ledgers (``train.islands``;
+  docs/parallelism.md "Islands")
 - :mod:`tenancy` — TenantRegistry: SLO classes, KV-block quotas, fair-share
   preemption (docs/serving.md "Multi-tenancy and SLO classes")
 - :mod:`scenario` — deterministic multi-tenant chaos scenario harness
@@ -18,6 +21,7 @@ batching + prefix sharing). See docs/serving.md.
 from trlx_tpu.serving.allocator import PagedBlockAllocator, SeqBlocks
 from trlx_tpu.serving.client import GenerationClient
 from trlx_tpu.serving.engine import ServingEngine
+from trlx_tpu.serving.island import GenerationIsland
 from trlx_tpu.serving.policy import (
     EngineDrainingError,
     EngineStoppedError,
@@ -46,6 +50,7 @@ __all__ = [
     "SeqBlocks",
     "GenerationClient",
     "ServingEngine",
+    "GenerationIsland",
     "InflightScheduler",
     "Request",
     "ServingResiliencePolicy",

@@ -58,6 +58,16 @@ class GenerationClient:
     def cancel(self, uid: int) -> bool:
         return self.engine.cancel(uid)
 
+    @property
+    def policy_version(self) -> int:
+        """Broadcast version the engine currently serves (islands mode;
+        -1 outside it). The producer stamps its rollout stats with this —
+        the *behavior* policy version as the island actually observed it,
+        which may run a round or two ahead of the publisher snapshot the
+        producer scored against (the staleness accountant's clipped-IS
+        correction absorbs exactly that drift)."""
+        return int(getattr(self.engine, "serving_version", -1))
+
     def _request(self, uid: int) -> Request:
         req = self.engine.scheduler.get_request(uid)
         if req is None:

@@ -58,6 +58,7 @@ this with a lock — rollout producers call through
 
 import os
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -255,6 +256,11 @@ class ServingEngine:
         # (held for a whole round — or indefinitely by a wedged one)
         self._draining = threading.Event()
         self._abort_evt = threading.Event()
+        # generation-island glue (attach_island): round-boundary version
+        # swaps + idle-bubble ledger. None keeps step() byte-identical to
+        # the single-island engine.
+        self._island = None
+        self._island_version = -1
 
         # device state
         self.cache = trunk.init_paged_cache(
@@ -848,6 +854,27 @@ class ServingEngine:
                 self._free_slot_state(slot)
         return finished
 
+    def attach_island(self, island) -> None:
+        """Run this engine as a generation island
+        (:class:`~trlx_tpu.serving.island.GenerationIsland`): every
+        :meth:`step` touches the island's round gate, polls its publisher for
+        a newly *committed* chunked broadcast — installing it via
+        :meth:`set_params`, i.e. exactly one prefix-cache flush per version,
+        atomically between rounds — and reports the round's busy interval to
+        the island's idle-bubble ledger.
+
+        Called only on a quiescent engine: at wiring time before the first
+        step, or by the supervisor's restart on a freshly built successor
+        before it adopts replay state — never with a round in flight."""
+        self._island = island  # graftcheck: noqa[CC001]
+        self._island_version = -1  # graftcheck: noqa[CC001]
+
+    @property
+    def serving_version(self) -> int:
+        """Broadcast version the engine currently serves (-1 before the
+        first island swap, or when no island is attached)."""
+        return self._island_version
+
     def request_abort(self) -> None:
         """Unstick a wedged step loop (called by the watchdog escalation or
         the supervisor's per-round wedge timer, from their own threads).
@@ -857,7 +884,22 @@ class ServingEngine:
 
     def step(self) -> List[Request]:
         """One engine round: admissions (bucketed prefill) + one decode step.
-        Returns requests finished during the round."""
+        Returns requests finished during the round. With an island attached,
+        the round boundary is also the atomic weight-swap point: the gate
+        touch serializes against an in-flight chunk install, and a committed
+        broadcast is installed before (never during) the round."""
+        island = self._island
+        t_round0 = 0.0
+        if island is not None:
+            gate = island.round_gate
+            gate.acquire()
+            gate.release()
+            upd = island.poll_swap(self._island_version)
+            if upd is not None:
+                version, params = upd
+                self.set_params(params)  # one prefix-cache flush per version
+                self._island_version = version
+            t_round0 = time.monotonic()
         with self._lock:
             if chaos.should_fail("serving-wedge"):
                 # model a wedged device loop: no heartbeat, no exception, no
@@ -884,7 +926,9 @@ class ServingEngine:
                         self._class_latency.setdefault(
                             req.slo_class, deque(maxlen=512)
                         ).append(req.latency_s)
-            return finished
+        if island is not None:
+            island.note_round(t_round0, time.monotonic())
+        return finished
 
     def begin_drain(self, shed_pending: bool = True) -> None:
         """Enter drain mode: reject new submits. ``shed_pending=False`` is the

@@ -92,6 +92,7 @@ class ServingSupervisor:
         self._engine = engine_factory()
         self._params = None
         self._params_set = False
+        self._island = None
         self._draining = False
         self.restarts = 0
         self.restart_history: List[Dict[str, Any]] = []
@@ -146,6 +147,21 @@ class ServingSupervisor:
             engine = self._engine
         engine.set_params(params)
 
+    def attach_island(self, island) -> None:
+        """Attach the generation island — remembered so every restarted
+        generation is re-attached: the successor's first round re-polls the
+        island's publisher and installs the newest committed broadcast (its
+        swap cursor starts at -1, so recovery is a fresh install, never a
+        torn one)."""
+        with self._lock:
+            self._island = island
+            engine = self._engine
+        engine.attach_island(island)
+
+    @property
+    def serving_version(self) -> int:
+        return self.engine.serving_version
+
     def note_overlap(self, decode_busy_s: float, overlapped_s: float) -> None:
         self.engine.note_overlap(decode_busy_s, overlapped_s)
 
@@ -193,6 +209,7 @@ class ServingSupervisor:
             old = self._engine
             params_set = self._params_set
             params = self._params
+            island = self._island
             draining = self._draining
         gauges.set("serving/restarts", float(n))
         if n > self.max_restarts:
@@ -225,6 +242,8 @@ class ServingSupervisor:
         new = self._factory()
         if params_set:
             new.set_params(params)
+        if island is not None:
+            new.attach_island(island)
         new.adopt(state)
         if draining:
             # mid-drain restart: keep rejecting new submits, but do NOT shed

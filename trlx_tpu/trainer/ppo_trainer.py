@@ -89,6 +89,11 @@ class PPOTrainer(MeshRLTrainer):
         self._async_cfg = None
         self._policy_version = 0
 
+        # generation-island runtime (trlx_tpu/serving/island; resolved in
+        # _start_async_engine when train.islands is enabled). None keeps the
+        # trainer byte-identical to the monolithic publish path.
+        self._island = None
+
         # prompt-stream position (trlx_tpu/resilience): draws from the
         # infinite prompt iterator, checkpointed and replayed on resume so a
         # restarted run continues the exact prompt sequence
@@ -669,7 +674,11 @@ class PPOTrainer(MeshRLTrainer):
         object changes (each publish / rollout-copy recast is a new tree)."""
         gen_params = params if params is not None else self.generation_params()
         tparams = gen_params["transformer"]
-        if tparams is not self._serving_param_ref:
+        if self._island is None and tparams is not self._serving_param_ref:
+            # islands mode skips this install: the engine self-swaps to the
+            # newest committed broadcast at its own round boundaries, and the
+            # producer's snapshot stays the behavior-scoring policy (the
+            # ≤1-version drift is absorbed by the clipped-IS correction)
             self._serving_engine.set_params(tparams)
             self._serving_param_ref = tparams
         with self.obs.span("generate"):
@@ -1263,7 +1272,39 @@ class PPOTrainer(MeshRLTrainer):
             with self.mesh:
                 return jax.jit(lambda t: jax.tree.map(lambda x: x.copy(), t))(tree)
 
-        publisher = ParameterPublisher(copy_fn=device_copy)
+        icfg = getattr(self.config.train, "islands", None)
+        if icfg is not None and icfg.enabled and self._serving_engine is None:
+            logger.warning(
+                "train.islands requires train.serving (the generation island "
+                "IS the continuous-batching engine): running the monolithic "
+                "publish path"
+            )
+            icfg = None
+        if icfg is not None and icfg.enabled:
+            from trlx_tpu.parallel.mesh import carve_islands
+            from trlx_tpu.rollout import ChunkedParameterPublisher
+            from trlx_tpu.serving import GenerationIsland
+
+            placement = carve_islands(icfg.gen_devices)
+            # published trees are full trainer params (transformer + heads);
+            # the serving engine runs only the transformer trunk
+            self._island = GenerationIsland(
+                self._serving_engine, param_selector=lambda tree: tree["transformer"]
+            )
+            publisher = ChunkedParameterPublisher(
+                copy_fn=device_copy,
+                chunk_layers=icfg.chunk_layers,
+                chunk_pause_s=icfg.chunk_pause_s,
+                round_gate=self._island.round_gate,
+            )
+            self._island.bind_publisher(publisher)
+            logger.info(
+                f"generation island carved: gen={len(placement.gen)} device(s), "
+                f"learn={len(placement.learn)} device(s), "
+                f"shared={placement.shared}, chunk_layers={icfg.chunk_layers}"
+            )
+        else:
+            publisher = ParameterPublisher(copy_fn=device_copy)
         self._policy_version = publisher.publish(self.params)
         capacity = cfg.queue_capacity or 4 * self.method.num_rollouts
         queue = ExperienceQueue(capacity, cfg.high_watermark, cfg.low_watermark)
@@ -1297,6 +1338,10 @@ class PPOTrainer(MeshRLTrainer):
         else:
             self._engine = make_engine()
         self._engine.start()
+        if self._island is not None:
+            # windows open after the seed publish, so the first broadcast's
+            # compile/copy cost never pollutes the idle-bubble fractions
+            self._island.open_window()
         logger.info(
             f"async rollout engine started{' (supervised)' if supervised else ''}: "
             f"queue_capacity={capacity} "
@@ -1332,6 +1377,12 @@ class PPOTrainer(MeshRLTrainer):
             "time/rollout_chunk_time": time.monotonic() - t0,
             "rollout/producer_version": float(version),
         }
+        if self._island is not None and self._serving_client is not None:
+            # behavior policy as actually served (the island may have swapped
+            # mid-batch; drift vs. `version` is what clipped-IS absorbs)
+            self.rollout_stats["rollout/served_version"] = float(
+                self._serving_client.policy_version
+            )
         return elements
 
     def _refill_store_async(self):
@@ -1376,6 +1427,12 @@ class PPOTrainer(MeshRLTrainer):
         self._fast_forward_prompt_stream()
         self._resolve_serving()
         self._async_cfg = self._resolve_async_config()
+        icfg = getattr(self.config.train, "islands", None)
+        if icfg is not None and icfg.enabled and self._async_cfg is None:
+            logger.warning(
+                "train.islands requires train.async_rollouts (the bounded "
+                "experience queue is the island seam): islands disabled"
+            )
         if self._async_cfg is not None:
             self._start_async_engine()
             self._refill_store_async()
@@ -1478,9 +1535,14 @@ class PPOTrainer(MeshRLTrainer):
         step = self._get_train_step(
             batch.query_tensors.shape[0], batch.query_tensors.shape[1], batch.response_tensors.shape[1]
         )
+        t_learn0 = time.monotonic()
         with self.mesh:
             self.params, self.opt_state, stats = step(self.params, self.opt_state, dbatch)
         out = {k: float(v) for k, v in jax.device_get(stats).items()}
+        if self._island is not None:
+            # device_get above synced the step; the interval is real compute
+            self._island.note_learn(t_learn0, time.monotonic())
+            self._island.export_gauges()
         out.update(self.rollout_stats)
         if self._engine is not None:
             out.update(gauges.snapshot("rollout/"))
@@ -1496,8 +1558,13 @@ class PPOTrainer(MeshRLTrainer):
         if self._engine is not None and (
             self.iter_count % max(1, self._async_cfg.publish_interval) == 0
         ):
+            t_pub0 = time.monotonic()
             self._policy_version = self._engine.publisher.publish(self.params)
             gauges.set("rollout/learner_version", float(self._policy_version))
+            if self._island is not None:
+                # the broadcast runs on the learner island's thread — it is
+                # learner busy time, even though the chunks hide under decode
+                self._island.note_learn(t_pub0, time.monotonic())
 
     def post_epoch_callback(self, epoch: int):
         """Discard stale rollouts and collect fresh experience (parity: :219-225).
@@ -1552,6 +1619,7 @@ class PPOTrainer(MeshRLTrainer):
         exception; a producer death during training already surfaces through
         collect()."""
         engine, self._engine = self._engine, None
+        island, self._island = self._island, None
         if engine is None:
             return
         try:
@@ -1559,3 +1627,8 @@ class PPOTrainer(MeshRLTrainer):
             logger.info(f"async rollout engine stopped: {stats}")
         except Exception as e:
             logger.warning(f"async rollout engine teardown: {type(e).__name__}: {e}")
+        finally:
+            if island is not None:
+                # final numbers before the prefix-aware gauge clear
+                logger.info(f"generation island closed: {island.summary()}")
+                island.close()
