@@ -230,6 +230,28 @@ if JAX_PLATFORMS=cpu TRLX_ISLAND_SEED_REGRESSION=blocking_broadcast timeout -k 1
 fi
 echo "seeded blocking_broadcast correctly rejected"
 
+echo "== learner-overlap parity tests (CPU)"
+# overlapped-collective FSDP learner: accum=N whole-batch parity, bitwise
+# overlap-off identity to the pre-overlap program, donation aliasing, int8
+# sharded optimizer tolerance, reduce-scatter-not-allreduce IR shape, and the
+# committed IR006 memory comparison (docs/parallelism.md "Learner overlap &
+# FSDP"); bounded like the other suites
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_learner_overlap.py -q -m "not slow" -p no:cacheprovider
+
+echo "== learner-overlap seeded-allreduce gate (must fail the IR budget)"
+# the overlap gate proves itself like the conc/spec/tenant gates: replace the
+# differentiate-through-gather reduce-scatter path with a full-gradient
+# all-reduce over fsdp (TRLX_IR_SEED_REGRESSION=allreduce_under_fsdp) and
+# require the committed IR005 budget to REJECT the lowered step — a budget
+# that accepts the bandwidth-pessimal schedule is not guarding the overlap
+if TRLX_IR_SEED_REGRESSION=allreduce_under_fsdp timeout -k 10 900 \
+    python -m trlx_tpu.analysis.ir --entry ppo_train_step_overlap > /dev/null 2>&1; then
+    echo "FATAL: seeded allreduce_under_fsdp regression was NOT caught by the IR budget gate" >&2
+    exit 1
+fi
+echo "seeded allreduce_under_fsdp correctly rejected"
+
 echo "== chaos soak smoke (CPU)"
 # the acceptance scenario by name: producer crashes + nan-loss + bad elements
 # + reward faults in one run, every recovery visible in gauges/summary

@@ -13,7 +13,9 @@ module scope, so it must not pull in jax. Builders do their heavy imports
 lazily when called.
 
 Seeded regressions: builders honor ``TRLX_IR_SEED_REGRESSION`` (values
-``f32_upcast`` / ``allgather``) by injecting a deliberate defect into the
+``f32_upcast`` / ``allgather`` / ``allreduce_under_fsdp`` — the last replaces
+the overlapped step's reduce-scatter backward with a full-gradient all-reduce
+over ``fsdp``, ``parallel/fsdp.py``) by injecting a deliberate defect into the
 built step. CI uses this to prove the gate actually fails closed; it must
 never be set when writing the committed budget.
 """

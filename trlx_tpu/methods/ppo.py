@@ -355,3 +355,149 @@ def build_ppo_train_step(spec: str, mesh) -> EntryArtifacts:
         f32_allow=frozenset({"dot_general:3"}),
         meta=dict(batch=B, prompt=P, response=R, num_microbatches=num_mb),
     )
+
+
+def _ppo_audit_loss_fn(module, method, mesh, R: int):
+    """The audit-shape PPO loss shared by the overlap entrypoints: same
+    construction as ``build_ppo_train_step``'s, minus the seeds (the overlap
+    seed lives in ``parallel/fsdp.py``'s step builder, not the loss)."""
+    from trlx_tpu.utils.modeling import logprobs_of_labels
+
+    def loss_fn(params, mb):
+        seq = jnp.concatenate([mb.query_tensors, mb.response_tensors], axis=1)
+        mask = jnp.concatenate([mb.attention_mask, mb.response_mask], axis=1)
+        logits, values_pred, _, _ = module.apply({"params": params}, seq, mask)
+        logprobs = logprobs_of_labels(logits[:, :-1], seq[:, 1:])
+        start = mb.query_tensors.shape[1] - 1
+        logprobs = logprobs[:, start:start + R]
+        values_pred = values_pred[:, start:start + R].astype(jnp.float32)
+        advantages, returns = method.get_advantages_and_returns(
+            mb.values, mb.rewards, mb.response_mask
+        )
+        loss, _ = method.loss(
+            logprobs, values_pred, mb.logprobs, mb.values, advantages, returns,
+            mb.response_mask,
+        )
+        return loss
+
+    return loss_fn
+
+
+@register_entrypoint(
+    "ppo_train_step_overlap",
+    specs=("small",),
+    mesh={"data": 2, "fsdp": 2, "pipe": 1, "model": 1},
+)
+def build_ppo_train_step_overlap(spec: str, mesh) -> EntryArtifacts:
+    """The overlapped-collective FSDP learner step (``train.learner_overlap``,
+    ``parallel/fsdp.py``) as graftcheck-ir audits it: explicit shard_map
+    collectives — per-leaf parameter all-gather in the forward, whose AD
+    transpose reduce-scatters the gradient per-leaf during the backward —
+    with a gradient-shard accumulation carry and a ZeRO-sharded optimizer
+    update. The committed IR005 budget for this entry must show
+    ``reduce-scatter:fsdp`` / ``all-gather:fsdp`` and NO ``all-reduce:fsdp``;
+    ``TRLX_IR_SEED_REGRESSION=allreduce_under_fsdp`` (handled by the step
+    builder) restores the full-gradient all-reduce so CI can prove the budget
+    rejects it. Audits on a pure data/fsdp mesh — the overlap path's
+    requirement (``fsdp.can_overlap``).
+    """
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from trlx_tpu.data.ppo_types import PPORLBatch
+    from trlx_tpu.models.policy import CausalLMWithValueHead
+    from trlx_tpu.models.presets import PRESETS
+    from trlx_tpu.parallel import fsdp as fsdp_lib
+    from trlx_tpu.parallel.mesh import BATCH_AXES
+
+    dims = {"small": dict(hidden=64, layers=2, heads=4, vocab=256, B=8, P=24, R=8)}[spec]
+    model_config = PRESETS["gpt2"].replace(
+        vocab_size=dims["vocab"], hidden_size=dims["hidden"],
+        num_layers=dims["layers"], num_heads=dims["heads"],
+        intermediate_size=4 * dims["hidden"], max_position_embeddings=64,
+        param_dtype=jnp.float32, compute_dtype=jnp.bfloat16,
+    )
+    module = CausalLMWithValueHead(model_config)
+    method = PPOConfig()
+
+    params_shape = jax.eval_shape(
+        lambda: module.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 2), jnp.int32), jnp.ones((1, 2), jnp.int32)
+        )
+    )["params"]
+    tx = optax.adamw(1e-5)
+    specs = fsdp_lib.make_overlap_specs(params_shape, tx, mesh)
+    abs_params = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=NamedSharding(mesh, s)),
+        params_shape, specs.param_specs,
+    )
+    abs_opt = fsdp_lib.global_state_struct(specs, mesh)
+
+    B, P, R = dims["B"], dims["P"], dims["R"]
+    bsh = NamedSharding(mesh, PartitionSpec(BATCH_AXES, None))
+
+    def babs(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=bsh)
+
+    abs_batch = PPORLBatch(
+        query_tensors=babs((B, P), jnp.int32),
+        response_tensors=babs((B, R), jnp.int32),
+        logprobs=babs((B, R), jnp.float32),
+        values=babs((B, R), jnp.float32),
+        rewards=babs((B, R), jnp.float32),
+        attention_mask=babs((B, P), jnp.int32),
+        response_mask=babs((B, R), jnp.int32),
+    )
+    num_mb = 2
+    loss_fn = _ppo_audit_loss_fn(module, method, mesh, R)
+    step = fsdp_lib.make_overlapped_grad_accum_step(
+        loss_fn, tx, specs, mesh, num_mb, has_aux=False, max_grad_norm=1.0,
+    )
+
+    def train_step(params, opt_state, batch):
+        new_params, new_opt, _ = step(params, opt_state, batch)
+        return new_params, new_opt
+
+    return EntryArtifacts(
+        fn=train_step,
+        args=(abs_params, abs_opt, abs_batch),
+        donate_argnums=(0, 1),
+        compute_dtype="bfloat16",
+        f32_allow=frozenset({"dot_general:3"}),
+        meta=dict(
+            batch=B, prompt=P, response=R, num_microbatches=num_mb,
+            overlap=True, sharded_opt_state=True,
+        ),
+    )
+
+
+@register_entrypoint(
+    "ppo_train_step_unsharded_opt",
+    specs=("small",),
+    mesh={"data": 2, "fsdp": 2, "pipe": 1, "model": 1},
+)
+def build_ppo_train_step_unsharded_opt(spec: str, mesh) -> EntryArtifacts:
+    """Memory comparator for the overlap entry (IR006): the plain GSPMD step
+    with deliberately REPLICATED optimizer state, on the same pure data/fsdp
+    mesh as ``ppo_train_step_overlap``. The committed budget pins both
+    entries' ``memory_bytes``; the overlap entry (sharded state + shard-local
+    update) must stay strictly below this one — asserted by
+    ``tests/test_learner_overlap.py`` against the committed budget and
+    re-checked on every regeneration.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    art = build_ppo_train_step(spec, mesh)
+    repl = NamedSharding(mesh, PartitionSpec())
+    abs_params, abs_opt, abs_batch = art.args
+    abs_opt = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=repl), abs_opt
+    )
+    return EntryArtifacts(
+        fn=art.fn,
+        args=(abs_params, abs_opt, abs_batch),
+        donate_argnums=art.donate_argnums,
+        compute_dtype=art.compute_dtype,
+        f32_allow=art.f32_allow,
+        meta=dict(art.meta, unsharded_opt_state=True),
+    )

@@ -94,7 +94,7 @@ class TransformerConfig:
     depth_scaled_init: bool = True
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
-    remat: str = "none"  # "none" | "full" | "nothing_saveable" | "dots_saveable"
+    remat: str = "none"  # "none" | "full" | "per_layer" | "nothing_saveable" | "dots_saveable"
     attention_impl: str = "xla"  # "xla" | "flash" (Pallas) | "ring" (sequence-parallel)
     # int8 KV cache (per-row symmetric quantization over the head dim): at wide
     # decode batches the KV cache dominates decode HBM traffic, so halving its
@@ -166,9 +166,14 @@ class TransformerConfig:
 
 def remat_policy(name: str):
     """Rematerialization policy by config name (shared by the listed-layer stack
-    and the pipelined stage scan)."""
+    and the pipelined stage scan). ``per_layer`` = save only the block-boundary
+    residuals (an ``nn.remat`` with no policy), the scale-appropriate middle
+    ground between ``nothing_saveable`` (recompute everything, xl-class) and
+    ``dots_saveable`` (keep matmul outputs, small models) — guidance per model
+    scale in docs/parallelism.md "Learner overlap & FSDP"."""
     return {
         "full": None,
+        "per_layer": None,
         "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
         "dots_saveable": jax.checkpoint_policies.dots_saveable,
     }[name]

@@ -35,6 +35,7 @@ real-TPU lowering failure in round 2; interpret mode on CPU never checks).
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -44,9 +45,26 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
-# "pallas" (default) or "xla": tests flip this to check grad parity between the
-# Pallas backward kernels and the XLA recompute fallback.
-BACKWARD_IMPL = "pallas"
+# "pallas" (default) or "xla": which backward the flash custom_vjp traces.
+# Pallas recomputes attention per block from the saved logsumexp — O(T·block)
+# memory, mandatory at long context — but the recompute costs real throughput
+# at small context: switching the backward from the XLA O(T·S) recompute to
+# the Pallas kernels is what slid gpt2-small train MFU 0.43 -> 0.30 between
+# bench rounds r02 and r05 (S=256, where the materialized score matrix is
+# cheap). Pick per scale via set_flash_backward / TRLX_FLASH_BWD; tests also
+# flip this to check grad parity between the two backwards.
+BACKWARD_IMPL = os.environ.get("TRLX_FLASH_BWD", "pallas")
+
+
+def set_flash_backward(impl: str) -> str:
+    """Select the flash-attention backward ("pallas" | "xla") for subsequent
+    traces; returns the previous value. The choice is captured at trace time,
+    so set it before the train step is first jitted."""
+    global BACKWARD_IMPL
+    if impl not in ("pallas", "xla"):
+        raise ValueError(f"flash backward must be 'pallas' or 'xla', got {impl!r}")
+    prev, BACKWARD_IMPL = BACKWARD_IMPL, impl
+    return prev
 
 LANES = 8  # trailing lane width for per-row tensors (lse / delta / kv mask rows)
 

@@ -18,7 +18,16 @@ from trlx_tpu.parallel.sharding import (
     ambient_mesh,
     constrain_seq,
     default_lm_rules,
+    in_manual_axes,
     make_param_shardings,
     make_param_specs,
+    manual_axes,
     shard_params,
+)
+from trlx_tpu.parallel.fsdp import (
+    OverlapSpecs,
+    can_overlap,
+    make_overlap_specs,
+    make_overlapped_grad_accum_step,
+    make_sharded_opt_init,
 )

@@ -8,7 +8,9 @@ matched against ordered regex rules yielding a ``PartitionSpec``. FSDP shards th
 largest remaining dim over ``fsdp``; TP shards feature dims over ``model``.
 """
 
+import contextlib
 import re
+import threading
 from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -194,6 +196,34 @@ def make_state_shardings(state_tree: Any, mesh: Mesh, rules: Optional[Sequence[R
     return treedef.unflatten(shardings)
 
 
+_manual_mode = threading.local()
+
+
+@contextlib.contextmanager
+def manual_axes():
+    """Mark the enclosing trace as *manually mapped* (inside a ``shard_map``
+    body, e.g. the overlapped FSDP step in :mod:`trlx_tpu.parallel.fsdp`).
+
+    ``with_sharding_constraint`` is illegal on axes that are manual —
+    :func:`constrain_gathered` / :func:`constrain_seq` become no-ops under
+    this context so the model code can run unchanged inside shard_map.
+    Checking ``ambient_mesh()`` is not enough: the trainer traces the
+    shard_map body under ``with self.mesh:``, where the ambient mesh is live.
+    """
+    prev = getattr(_manual_mode, "depth", 0)
+    # trace-time-only mutation is the POINT: the guard changes how constrain_*
+    # helpers trace, not what the compiled step computes per-iteration
+    _manual_mode.depth = prev + 1  # graftcheck: noqa[JX003]
+    try:
+        yield
+    finally:
+        _manual_mode.depth = prev  # graftcheck: noqa[JX003]
+
+
+def in_manual_axes() -> bool:
+    return getattr(_manual_mode, "depth", 0) > 0
+
+
 _warned_no_mesh_api = False
 
 
@@ -227,6 +257,8 @@ def constrain_gathered(x: jax.Array) -> jax.Array:
     """Gather the sequence dim back before the LM/value heads (the analogue of
     Megatron's ``gather_from_sequence_parallel_region``, reference
     modeling_nemo_ppo.py:160-164): batch stays sharded, everything else whole."""
+    if in_manual_axes():
+        return x
     mesh = ambient_mesh()
     if mesh is None or not batch_divisible(mesh, x.shape[0]):
         return x
@@ -244,6 +276,8 @@ def constrain_seq(x: jax.Array, seq_dim: int = 1) -> jax.Array:
     the all-gather before TP matmuls and the reduce-scatter after, which is
     exactly Megatron SP's gather/scatter pair. No-op outside a mesh context or
     when the sequence length does not divide the axis."""
+    if in_manual_axes():
+        return x
     mesh = ambient_mesh()
     if mesh is None:
         return x

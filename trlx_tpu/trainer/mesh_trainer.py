@@ -249,6 +249,38 @@ class MeshRLTrainer(BaseRLTrainer):
 
         return build(params)
 
+    def _learner_overlap_active(self) -> bool:
+        """Whether the overlapped-collective FSDP step (``train.learner_overlap``,
+        ``trlx_tpu/parallel/fsdp.py``) replaces the GSPMD grad-accum step.
+
+        Config-level gate (``self.health`` does not exist yet during
+        ``setup_optimizer``): requires a pure data/fsdp mesh — the shard_map
+        body computes the full model locally, so TP (``model > 1``) and PP
+        (``pipe > 1``) fall back — and no self-healing guard (the on-device
+        skip guard is built into the GSPMD step only). Falls back with a
+        warning, never raises: off-path runs stay byte-identical.
+        """
+        cfg = getattr(self.config.train, "learner_overlap", None)
+        if cfg is None or not cfg.enabled:
+            return False
+        from trlx_tpu.parallel.fsdp import can_overlap
+
+        if not can_overlap(self.mesh):
+            logger.warning(
+                "train.learner_overlap requires a pure data/fsdp mesh "
+                f"(model=1, pipe=1), got {dict(self.mesh.shape)}: falling back "
+                "to the GSPMD train step"
+            )
+            return False
+        if self.config.train.self_healing.enabled:
+            logger.warning(
+                "train.learner_overlap is incompatible with the self-healing "
+                "health guard (on-device skip lives in the GSPMD step): "
+                "falling back to the GSPMD train step"
+            )
+            return False
+        return True
+
     def setup_optimizer(self):
         """optax optimizer + schedule from the registries (parity:
         accelerate_base_trainer.py:173-201), masked by the freeze predicate, with
@@ -262,11 +294,42 @@ class MeshRLTrainer(BaseRLTrainer):
             learning_rate=sched_lr, **sched_kwargs
         )
         max_grad_norm = kwargs.pop("max_grad_norm", None)
-        tx = get_optimizer_class(opt_config.name)(learning_rate=self.lr_schedule, **kwargs)
-        if max_grad_norm:
+        overlap = self._learner_overlap_active()
+        opt_name = opt_config.name
+        if overlap and self.config.train.learner_overlap.int8_opt_state:
+            # ZeRO + int8: blockwise-quantized Adam moments over each device's
+            # LOCAL shard (ops/quantized_adam.py) — the block layout must be
+            # shard-local, so this option only exists under the overlap step
+            if str(opt_name).lower() in ("adam", "adamw", "adamw_8bit_bnb"):
+                opt_name = "adamw_8bit_bnb"
+            else:
+                logger.warning(
+                    f"learner_overlap.int8_opt_state ignored: optimizer "
+                    f"{opt_name!r} is not adam-family"
+                )
+        tx = get_optimizer_class(opt_name)(learning_rate=self.lr_schedule, **kwargs)
+        # Under the overlapped step, global-norm clipping cannot be an optax
+        # link: the transform would see only this device's gradient SHARD.
+        # The step computes the shard-aware global norm itself.
+        self._overlap_max_grad_norm = max_grad_norm if overlap else None
+        if max_grad_norm and not overlap:
             tx = optax.chain(optax.clip_by_global_norm(max_grad_norm), tx)
         labels = self._trainable_labels(self.params)
         self.tx = optax.multi_transform({"train": tx, "freeze": optax.set_to_zero()}, labels)
+        if overlap:
+            # ZeRO-sharded init: tx.init runs INSIDE shard_map on each
+            # device's parameter shard, so the moments are born shard-local —
+            # required for the int8 option (quantization blocks must tile the
+            # local shard) and never materializes full-size state anywhere
+            from trlx_tpu.parallel import fsdp as fsdp_lib
+
+            self._overlap_specs = fsdp_lib.make_overlap_specs(
+                self.params, self.tx, self.mesh
+            )
+            init = fsdp_lib.make_sharded_opt_init(self.tx, self._overlap_specs, self.mesh)
+            with self.mesh:
+                self.opt_state = init(self.params)
+            return
         # Explicit state shardings: moment leaves take their param's layout by
         # key path, scalars replicate. Leaving this to GSPMD propagation
         # REPLICATES the moments (zeros_like outputs carry no input-derived
@@ -300,7 +363,34 @@ class MeshRLTrainer(BaseRLTrainer):
         traced argument precisely so the guard's rolling threshold never
         triggers a retrace. Without a guard the exact original program is
         compiled — off-config runs stay bit-identical.
+
+        With ``train.learner_overlap`` active the step is instead built by
+        :func:`trlx_tpu.parallel.fsdp.make_overlapped_grad_accum_step` —
+        explicit shard_map collectives (per-leaf allgather forward,
+        reduce-scatter backward), a gradient-SHARD accumulation carry, and a
+        shard-local optimizer update over the ZeRO state from
+        ``setup_optimizer``. The overlap-off program below is untouched.
         """
+        if self._learner_overlap_active():
+            from trlx_tpu.parallel import fsdp as fsdp_lib
+
+            lov = self.config.train.learner_overlap
+            logger.info(
+                "learner_overlap: overlapped FSDP step active "
+                f"(fsdp={self.mesh.shape['fsdp']}, num_microbatches={num_mb}, "
+                f"int8_opt_state={lov.int8_opt_state}, remat={lov.remat}, "
+                f"flash_bwd={lov.flash_bwd}, max_grad_norm={self._overlap_max_grad_norm})"
+            )
+            return fsdp_lib.make_overlapped_grad_accum_step(
+                loss_fn,
+                self.tx,
+                self._overlap_specs,
+                self.mesh,
+                num_mb,
+                max_grad_norm=self._overlap_max_grad_norm,
+                lr_schedule=self.lr_schedule,
+                donate=donate,
+            )
 
         def compute_update(params, opt_state, batch):
             mbs = jax.tree.map(lambda x: x.reshape((num_mb, x.shape[0] // num_mb) + x.shape[1:]), batch)

@@ -148,6 +148,17 @@ class PPOTrainer(MeshRLTrainer):
         if self.is_seq2seq:
             self._setup_seq2seq_model(overrides)
             return
+        # per-scale remat override for the overlapped learner (docs/parallelism.md
+        # "Learner overlap & FSDP"): learner_overlap.remat, when set, beats
+        # mesh.remat but still yields to explicit model_overrides
+        lov = getattr(self.config.train, "learner_overlap", None)
+        if lov is not None and lov.enabled and lov.remat is not None:
+            overrides.setdefault("remat", lov.remat)
+        if lov is not None and lov.enabled and lov.flash_bwd is not None:
+            # captured at trace time, so set before the step is first jitted
+            from trlx_tpu.ops.attention import set_flash_backward
+
+            set_flash_backward(lov.flash_bwd)
         overrides.setdefault("remat", self.config.mesh.remat)
         overrides.setdefault("sequence_sharding", self.config.mesh.sequence_shard)
         from trlx_tpu.models.hf_loading import merge_loaded_params, peft_overrides
