@@ -648,6 +648,99 @@ def _serving_tenant_perf(jax):
     }
 
 
+def _fleet_perf(jax):
+    """Serving-fleet leg: fleet-wide throughput, per-SLO-class latency tails,
+    prefix-affinity hit rate and autoscale/kill churn over N engine replicas
+    behind the FleetRouter (docs/serving.md "Fleet serving").
+
+    The same mixed-class tenant traffic as the tenants leg, but spread over a
+    3-replica fleet through the fleet scenario harness with the gauge-driven
+    autoscaler live and the fleet chaos sites armed: one hard replica kill
+    (cross-replica re-route) plus deliberate mis-routes, then an idle tail so
+    the scale-down drain fires inside the measured window. The affinity hit
+    rate is the routing-quality headline — it must beat the uniform-random
+    baseline or the prefix-affinity scoring is not paying for itself."""
+    from trlx_tpu.fleet import run_fleet_scenario
+    from trlx_tpu.models.presets import PRESETS
+    from trlx_tpu.models.transformer import TransformerLM
+    from trlx_tpu.serving import (
+        ServingEngine,
+        ServingResiliencePolicy,
+        TenantRegistry,
+        TenantTraffic,
+    )
+
+    import jax.numpy as jnp
+
+    on_cpu = jax.default_backend() == "cpu"
+    base = PRESETS["gpt2"].replace(
+        compute_dtype=jnp.float32 if on_cpu else jnp.bfloat16
+    )
+    S, P, N, n_lo, n_hi = (3, 12, 8, 12, 6) if on_cpu else (16, 64, 32, 64, 32)
+    bs = 4 if on_cpu else 16
+    max_len = P + N + 4  # +4: the pro streams prepend a shared prefix
+    blocks_per_req = -(-max_len // bs)
+
+    trunk = TransformerLM(base)
+    params = trunk.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 8), jnp.int32), jnp.ones((1, 8), jnp.int32),
+    )["params"]
+
+    reg = TenantRegistry(class_ttl_s={0: 8.0, 1: 16.0})
+    reg.register("free1", slo_class=0, kv_block_quota=blocks_per_req)
+    reg.register("free2", slo_class=0, kv_block_quota=blocks_per_req)
+    reg.register("pro1", slo_class=1)
+    reg.register("pro2", slo_class=1)
+    policy = ServingResiliencePolicy(
+        max_pending=16, high_watermark=1.0, low_watermark=0.5, preemption=True
+    )
+
+    def factory(seat):
+        return ServingEngine(
+            trunk, params, num_slots=S, max_seq_len=max_len, block_size=bs,
+            num_blocks=1 + 2 * S * blocks_per_req // 3, eos_token_id=None,
+            pad_token_id=0, gen_kwargs=dict(do_sample=False), seed=seat,
+            policy=policy, prefix_caching=True, tenants=reg,
+        )
+
+    traffic = [
+        TenantTraffic("free1", num_requests=n_lo, arrivals_per_round=2.0,
+                      prompt_len=(4, P - 2), max_new=(4, N), vocab=base.vocab_size),
+        TenantTraffic("free2", num_requests=n_lo, arrivals_per_round=2.0,
+                      prompt_len=(4, P - 2), max_new=(4, N), vocab=base.vocab_size),
+        TenantTraffic("pro1", num_requests=n_hi, arrivals_per_round=0.5,
+                      prompt_len=(4, P - 2), max_new=(4, N), vocab=base.vocab_size,
+                      shared_prefix=4),
+        TenantTraffic("pro2", num_requests=n_hi, arrivals_per_round=0.5,
+                      prompt_len=(4, P - 2), max_new=(4, N), vocab=base.vocab_size,
+                      shared_prefix=4),
+    ]
+    t0 = time.time()
+    report = run_fleet_scenario(
+        factory, reg, traffic, num_replicas=3,
+        chaos_spec="fleet-replica-kill:1,fleet-route:2",
+        dt_s=0.05, max_rounds=800, seed=7,
+        wedge_timeout_s=2.0 if not on_cpu else 0.25,
+        autoscale=True, min_replicas=1, max_replicas=4,
+        scale_down_occupancy=0.3, breach_rounds=3, cooldown_rounds=4,
+        idle_tail_rounds=30,
+    )
+    elapsed = time.time() - t0
+    return {
+        "fleet_req_s": round(report.submitted / elapsed, 2),
+        "fleet_p99_latency_s_by_class": {
+            str(c): round(v, 4) for c, v in sorted(report.p99_by_class.items())
+        },
+        "fleet_affinity_hit_rate": round(float(report.affinity_hit_rate), 4),
+        "fleet_random_hit_rate": round(float(report.random_hit_rate), 4),
+        "fleet_autoscale_events": len(report.autoscale_events),
+        "fleet_replica_kills": int(report.replica_kills),
+        "fleet_quota_violations": int(report.quota_violations),
+        "fleet_restarts": int(report.restarts),
+    }
+
+
 def _serving_overlap_perf(jax):
     """Stream-overlapped PPO leg (docs/serving.md "Stream-overlapped PPO"):
     how much of the decode window the streaming pipeline fills with
@@ -1313,6 +1406,10 @@ def measure():
         result.update(legs.run("serving_tenants", lambda: _serving_tenant_perf(jax)))
     except Exception as e:
         result["serving_tenant_perf_error"] = f"{type(e).__name__}: {e}"[:300]
+    try:
+        result.update(legs.run("fleet", lambda: _fleet_perf(jax)))
+    except Exception as e:
+        result["fleet_perf_error"] = f"{type(e).__name__}: {e}"[:300]
     try:
         result.update(legs.run("serving_overlap", lambda: _serving_overlap_perf(jax)))
     except Exception as e:

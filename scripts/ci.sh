@@ -252,6 +252,29 @@ if TRLX_IR_SEED_REGRESSION=allreduce_under_fsdp timeout -k 10 900 \
 fi
 echo "seeded allreduce_under_fsdp correctly rejected"
 
+echo "== serving fleet tests + chaos soak (CPU)"
+# fleet layer: uid-block seating, prefix-affinity routing, autoscaler
+# hysteresis, replica-kill re-route, N=1 parity, and the fleet acceptance
+# soak (3 replicas, 4 tenants / 2 SLO classes, >=1 replica kill + >=1
+# autoscale drain mid-run, exactly-once fleet-wide, p99 ordering, zero
+# quota violations); bounded so a wedged replica loop fails fast
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_serving_fleet.py -q -m "not slow" -p no:cacheprovider
+
+echo "== fleet seeded-blind-router gate (blind_router must break affinity)"
+# the fleet gate proves itself like the conc/spec/tenant gates: degenerate
+# the router to pure least-loaded (TRLX_FLEET_SEED_REGRESSION=blind_router
+# zeroes the warm-prefix and stickiness terms in memory) and require the
+# affinity tests to FAIL — an affinity-hit-rate bar that a blind router can
+# clear is not measuring affinity
+if JAX_PLATFORMS=cpu TRLX_FLEET_SEED_REGRESSION=blind_router timeout -k 10 600 \
+    python -m pytest tests/test_serving_fleet.py -q -k "affinity" \
+    -p no:cacheprovider > /dev/null 2>&1; then
+    echo "FATAL: seeded blind_router regression was NOT caught by the affinity gate" >&2
+    exit 1
+fi
+echo "seeded blind_router correctly rejected"
+
 echo "== chaos soak smoke (CPU)"
 # the acceptance scenario by name: producer crashes + nan-loss + bad elements
 # + reward faults in one run, every recovery visible in gauges/summary

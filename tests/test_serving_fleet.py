@@ -1,0 +1,590 @@
+"""Serving fleet tests (docs/serving.md "Fleet serving"): uid-block seating
+and per-replica gauge namespacing, prefix-affinity routing (warm prefix beats
+least-loaded; tenant stickiness survives a load gap), autoscaler hysteresis
+(oscillating load never flaps; exactly one action per sustained breach, then
+a cooldown), replica-kill re-route with exactly-once terminal accounting,
+replica-tagged typed client errors, the fleet chaos soak (N=3 replicas,
+4 tenants / 2 SLO classes, >=1 kill + >=1 autoscale drain mid-run, per-class
+p99 ordering fleet-wide, zero quota violations, affinity beats random), and
+the N=1 parity contract: a fleet of one replica is uid- and token-identical
+to the bare engine."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.fleet import (
+    DRAINING,
+    UID_STRIDE,
+    FleetAutoscaler,
+    FleetRouter,
+    FleetScenarioReport,
+    run_fleet_scenario,
+)
+from trlx_tpu.models.presets import PRESETS
+from trlx_tpu.models.transformer import TransformerLM
+from trlx_tpu.resilience.chaos import chaos
+from trlx_tpu.serving import (
+    EngineDrainingError,
+    GenerationClient,
+    RequestShedError,
+    ServingEngine,
+    ServingResiliencePolicy,
+    ServingRestartBudgetExceeded,
+    TenantRegistry,
+    TenantTraffic,
+)
+from trlx_tpu.serving.scheduler import (
+    FINISH_CANCELLED,
+    FINISH_DEADLINE,
+    FINISH_EOS,
+    FINISH_LENGTH,
+    FINISH_SHED,
+    FINISH_STOP,
+)
+from trlx_tpu.utils.metrics import gauges
+
+pytestmark = [pytest.mark.serving, pytest.mark.serving_fleet]
+
+TINY = dict(
+    vocab_size=37, hidden_size=16, num_layers=2, num_heads=2,
+    max_position_embeddings=64, compute_dtype=jnp.float32,
+)
+
+TERMINAL_REASONS = {
+    FINISH_EOS, FINISH_STOP, FINISH_LENGTH, FINISH_CANCELLED,
+    FINISH_DEADLINE, FINISH_SHED,
+}
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    yield
+    chaos.configure(None)
+
+
+@pytest.fixture(scope="module")
+def tiny_engine_parts():
+    config = PRESETS["gpt2"].replace(**TINY)
+    model = TransformerLM(config)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32), jnp.ones((1, 4), jnp.int32)
+    )["params"]
+    return model, params, config
+
+
+def _make_engine(parts, *, num_slots=3, num_blocks=0, policy=None, max_seq_len=32,
+                 seed=0, prefix_caching=False, tenants=None, replica_id=None):
+    model, params, _ = parts
+    return ServingEngine(
+        model, params, num_slots=num_slots, max_seq_len=max_seq_len, block_size=4,
+        num_blocks=num_blocks, eos_token_id=None, pad_token_id=0,
+        gen_kwargs=dict(do_sample=False), seed=seed, policy=policy,
+        prefix_caching=prefix_caching, tenants=tenants, replica_id=replica_id,
+    )
+
+
+def _make_fleet(parts, num_replicas, tmp_path, *, factory=None, **kw):
+    """FleetRouter with test-friendly supervisor knobs (no watchdog thread,
+    fast backoff, diagnostics into tmp)."""
+    if factory is None:
+        def factory(seat):
+            return _make_engine(parts)
+    kw.setdefault("wedge_timeout_s", None)
+    kw.setdefault("backoff_base_s", 0.01)
+    kw.setdefault("diagnostics_dir", str(tmp_path))
+    return FleetRouter(factory, num_replicas, **kw)
+
+
+def _assert_greedy_equivalent(parts, prompt, gen_a, gen_b, tol=1e-3):
+    """Token-for-token greedy parity modulo genuine argmax float ties (same
+    contract as the resilience parity tests: a real routing/replay bug decodes
+    from the wrong context and diverges with a large logit gap)."""
+    model, params, _ = parts
+    assert len(gen_a) == len(gen_b)
+    for i, (ta, tb) in enumerate(zip(gen_a, gen_b)):
+        if ta == tb:
+            continue
+        ctx = list(prompt) + list(gen_a[:i])
+        ids = jnp.asarray([ctx], jnp.int32)
+        mask = jnp.ones_like(ids)
+        positions = jnp.arange(len(ctx), dtype=jnp.int32)[None]
+        cache = {**model.init_cache(1, len(ctx)), "index": 0}
+        logits, _, _, _ = model.apply({"params": params}, ids, mask, positions, cache)
+        last = np.asarray(logits[0, -1], np.float64)
+        gap = abs(last[ta] - last[tb])
+        assert gap < tol, (
+            f"greedy runs diverged at token {i} ({ta} vs {tb}) with logit gap "
+            f"{gap:.3e} — not a float tie: the runs decoded different contexts"
+        )
+        return
+
+
+# ------------------------------------------------------- seating/namespacing
+
+
+def test_uid_blocks_and_gauge_namespaces_per_seat(tiny_engine_parts, tmp_path):
+    """Each seat's scheduler counts uids from seat * UID_STRIDE and exports
+    gauges under serving/replica/<seat>/; close() clears every namespace."""
+    router = _make_fleet(tiny_engine_parts, 2, tmp_path)
+    try:
+        seats = [h.seat for h in router._active_handles()]
+        assert seats == [0, 1]
+        for h in router._active_handles():
+            eng = h.supervisor.engine
+            assert eng.gauge_prefix == f"serving/replica/{h.seat}/"
+            assert eng.replica_id == h.seat
+            assert eng.scheduler.uid_hwm == h.seat * UID_STRIDE
+        u0 = router.submit([1, 2, 3], 3)           # seat 0 (tie-break)
+        u1 = router.submit([4, 5, 6], 3)           # seat 1 (least loaded)
+        assert 0 <= u0 < UID_STRIDE <= u1 < 2 * UID_STRIDE
+        assert router.replica_of(u0) == 0 and router.replica_of(u1) == 1
+        done = router.run([u0, u1])
+        assert set(done) == {u0, u1}
+        router.export_gauges()
+        assert gauges.snapshot(prefix="serving/replica/0/")
+        assert gauges.snapshot(prefix="serving/replica/1/")
+        fleet = gauges.snapshot(prefix="fleet/")
+        assert fleet["fleet/replicas"] == 2.0
+        assert fleet["fleet/routed"] == 2.0
+        assert fleet["fleet/finished"] == 2.0
+    finally:
+        router.close()
+    assert gauges.snapshot(prefix="serving/") == {}
+    assert gauges.snapshot(prefix="fleet/") == {}
+
+
+def test_bare_engine_keeps_default_gauge_prefix(tiny_engine_parts):
+    """Outside a fleet nothing moves: the engine's gauges stay at serving/*."""
+    eng = _make_engine(tiny_engine_parts)
+    assert eng.gauge_prefix == "serving/" and eng.replica_id is None
+    uid = eng.submit([1, 2], 3)
+    eng.run([uid])
+    eng.export_gauges()
+    snap = gauges.snapshot(prefix="serving/")
+    assert snap and not any(k.startswith("serving/replica/") for k in snap)
+    assert "serving/live_slots" in snap
+    eng.close()
+    assert gauges.snapshot(prefix="serving/") == {}
+
+
+# ----------------------------------------------------------------- affinity
+
+
+def test_fleet_affinity_warm_prefix_beats_least_loaded(tiny_engine_parts, tmp_path):
+    """A replica holding the prompt's warm prefix blocks wins the route even
+    against a strictly less-loaded replica. (This is the deterministic half
+    of the ci.sh seeded gate: under TRLX_FLEET_SEED_REGRESSION=blind_router
+    the router degenerates to least-loaded and this test must FAIL.)"""
+    def factory(seat):
+        return _make_engine(tiny_engine_parts, num_slots=2, prefix_caching=True)
+
+    router = _make_fleet(tiny_engine_parts, 2, tmp_path, factory=factory)
+    try:
+        warm_prompt = [1, 2, 3, 4, 5, 6, 7, 8]  # 2 full blocks at block_size 4
+        u0 = router.submit(warm_prompt, 3, tenant_id="a")
+        assert router.replica_of(u0) == 0
+        router.run([u0])
+        seat0 = router._active_handles()[0].supervisor.engine
+        assert seat0.allocator.cached_prefix_blocks(warm_prompt) >= 2
+        # distinct tenant + cold prompt: lands on seat 0 by tie-break and
+        # loads it (1 pending / 2 slots)
+        filler = router.submit([9, 10], 3, tenant_id="b")
+        assert router.replica_of(filler) == 0
+        # third tenant re-asks the warm prompt: seat 1 is strictly less
+        # loaded, but seat 0's 2 warm blocks outweigh the load gap
+        probe = router.submit(warm_prompt, 3, tenant_id="c")
+        assert router.replica_of(probe) == 0, (
+            "warm-prefix affinity lost to least-loaded routing"
+        )
+        router.run([filler, probe])
+        s = router.ledger.summary()
+        assert s["fleet_affinity_hit_rate"] == pytest.approx(1 / 3)
+    finally:
+        router.close()
+
+
+def test_fleet_affinity_tenant_stickiness(tiny_engine_parts, tmp_path):
+    """With no warm prefix anywhere, a tenant's recent traffic pulls its next
+    request onto the same replica even across a load gap; an unseen tenant
+    still falls back to least-loaded."""
+    def factory(seat):
+        return _make_engine(tiny_engine_parts, num_slots=2)
+
+    router = _make_fleet(
+        tiny_engine_parts, 2, tmp_path, factory=factory,
+        tenant_weight=2.0, load_weight=0.5,
+    )
+    try:
+        u0 = router.submit([1, 2, 3], 3, tenant_id="t")
+        assert router.replica_of(u0) == 0
+        # seat 0 now carries load; stickiness (2.0) still beats the load
+        # penalty (0.5 * 0.5) for the same tenant...
+        u1 = router.submit([7, 8, 9], 3, tenant_id="t")
+        assert router.replica_of(u1) == 0
+        # ...while a tenant with no history routes by load alone
+        u2 = router.submit([4, 5, 6], 3, tenant_id="u")
+        assert router.replica_of(u2) == 1
+        router.run([u0, u1, u2])
+        assert router.ledger.summary()["fleet_sticky_hit_rate"] == pytest.approx(1 / 3)
+    finally:
+        router.close()
+
+
+def test_fleet_affinity_hit_rate_beats_random(tiny_engine_parts, tmp_path):
+    """Shared-prefix traffic through the scenario harness: the router's
+    warm-prefix hit rate must beat what uniform-random replica choice would
+    have scored. (The statistical half of the ci.sh blind_router gate.)"""
+    model, params, _ = tiny_engine_parts
+    reg = TenantRegistry()
+    reg.register("alpha", slo_class=0)
+    reg.register("beta", slo_class=0)
+
+    def factory(seat):
+        return ServingEngine(
+            model, params, num_slots=3, max_seq_len=32, block_size=4,
+            eos_token_id=None, pad_token_id=0, gen_kwargs=dict(do_sample=False),
+            seed=seat, prefix_caching=True, tenants=reg,
+        )
+
+    traffic = [
+        TenantTraffic("alpha", num_requests=8, arrivals_per_round=0.5,
+                      prompt_len=(2, 4), max_new=(3, 5), vocab=37,
+                      shared_prefix=8),
+        TenantTraffic("beta", num_requests=8, arrivals_per_round=0.5,
+                      prompt_len=(2, 4), max_new=(3, 5), vocab=37,
+                      shared_prefix=8),
+    ]
+    report = run_fleet_scenario(
+        factory, reg, traffic, num_replicas=3, autoscale=False,
+        dt_s=0.05, max_rounds=300, diagnostics_dir=str(tmp_path),
+    )
+    assert report.replica_kills == 0 and report.restarts == 0
+    assert report.affinity_hit_rate > report.random_hit_rate, (
+        f"affinity routing ({report.affinity_hit_rate:.3f}) did not beat the "
+        f"uniform-random baseline ({report.random_hit_rate:.3f})"
+    )
+    # each tenant's 8-token shared prefix pins it to one replica after its
+    # first completion: the bulk of routes must be warm
+    assert report.affinity_hit_rate > 0.5
+
+
+def test_fleet_seed_regression_env_validated(monkeypatch, tiny_engine_parts, tmp_path):
+    monkeypatch.setenv("TRLX_FLEET_SEED_REGRESSION", "bogus")
+    with pytest.raises(ValueError, match="TRLX_FLEET_SEED_REGRESSION"):
+        _make_fleet(tiny_engine_parts, 1, tmp_path)
+
+
+# --------------------------------------------------------------- autoscaler
+
+
+def test_autoscaler_hysteresis_no_flap(tiny_engine_parts, tmp_path):
+    """Oscillating load (2 hot rounds, then idle) never scales; a sustained
+    breach scales exactly once, then the cooldown blocks immediate reversal;
+    sustained idleness drains the newest replica back down."""
+    def factory(seat):
+        return _make_engine(tiny_engine_parts, num_slots=2)
+
+    router = _make_fleet(tiny_engine_parts, 1, tmp_path, factory=factory)
+    scaler = FleetAutoscaler(
+        router, min_replicas=1, max_replicas=2,
+        scale_up_pending_per_slot=1.0, scale_down_occupancy=0.5,
+        breach_rounds=3, cooldown_rounds=4,
+    )
+
+    def observe():
+        router.export_gauges()
+        scaler.observe()
+
+    try:
+        for _ in range(3):  # oscillate: 2 hot observes, then drain to idle
+            uids = [router.submit([i + 1, i + 2], 2) for i in range(6)]
+            observe()
+            observe()
+            router.run(uids)  # pending -> 0 before the third breach
+            observe()
+        assert scaler.events == [] and router.num_replicas == 1
+
+        # sustained breach: exactly one scale-up at breach_rounds
+        uids = [router.submit([i + 1, i + 2], 2) for i in range(6)]
+        observe()
+        observe()
+        assert router.num_replicas == 1
+        observe()
+        assert [e[1] for e in scaler.events] == ["up"]
+        assert router.num_replicas == 2
+        # cooldown: still-breaching observes take no further action
+        observe()
+        observe()
+        assert [e[1] for e in scaler.events] == ["up"]
+        router.run(uids)
+
+        # drain the cooldown, then sustained idleness drains one replica
+        for _ in range(8):
+            observe()
+        assert [e[1] for e in scaler.events] == ["up", "drain"]
+        draining = [h for h in router._live_handles() if h.state == DRAINING]
+        assert [h.seat for h in draining] == [1]  # newest seat drains first
+        router.step()  # idle drain retires immediately
+        assert router.num_replicas == 1
+        assert [h.seat for h in router._active_handles()] == [0]
+    finally:
+        router.close()
+
+
+def test_autoscaler_validates_bounds(tiny_engine_parts, tmp_path):
+    router = _make_fleet(tiny_engine_parts, 1, tmp_path)
+    try:
+        with pytest.raises(ValueError, match="min_replicas"):
+            FleetAutoscaler(router, min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError, match="breach_rounds"):
+            FleetAutoscaler(router, breach_rounds=0)
+    finally:
+        router.close()
+
+
+# ------------------------------------------------------------ kill/re-route
+
+
+def test_replica_kill_reroutes_and_finishes_exactly_once(tiny_engine_parts, tmp_path):
+    """Chaos kills the busiest replica mid-flight: its live + pending
+    requests are adopted by the survivor, keep their uids, and every uid
+    reaches exactly one terminal state."""
+    def factory(seat):
+        return _make_engine(tiny_engine_parts, num_slots=2)
+
+    router = _make_fleet(tiny_engine_parts, 2, tmp_path, factory=factory)
+    try:
+        uids = [router.submit([i + 1, i + 2, i + 3], 4) for i in range(6)]
+        assert {router.replica_of(u) for u in uids} == {0, 1}  # both seats used
+        router.step()  # decode at least one token so replay carries state
+        chaos.configure("fleet-replica-kill:1")
+        done = router.run(uids)
+        assert set(done) == set(uids)
+        assert all(done[u].finish_reason == FINISH_LENGTH for u in uids)
+        s = router.ledger.summary()
+        assert s["fleet_replica_kills"] == 1 and s["fleet_reroutes"] >= 1
+        survivor = router._active_handles()
+        assert len(survivor) == 1
+        # ownership followed the requests onto the survivor
+        assert all(router.replica_of(u) == survivor[0].seat for u in uids)
+        assert chaos.stats().get("fleet-replica-kill") == 1
+    finally:
+        router.close()
+
+
+def test_fleet_fails_closed_with_no_active_replica(tiny_engine_parts, tmp_path):
+    router = _make_fleet(tiny_engine_parts, 1, tmp_path)
+    router.close()
+    with pytest.raises(ServingRestartBudgetExceeded, match="no active replica"):
+        router.submit([1, 2], 2)
+
+
+# ----------------------------------------------------- replica-tagged errors
+
+
+def test_typed_errors_carry_replica_id(tiny_engine_parts, tmp_path):
+    """Engine-raised and client-raised typed errors both say WHICH replica
+    failed the request — fleet callers distinguish engine-fatal from
+    request-fatal without string parsing."""
+    eng = _make_engine(tiny_engine_parts, replica_id=7)
+    eng.begin_drain()
+    with pytest.raises(EngineDrainingError) as ei:
+        eng.submit([1, 2], 2)
+    assert ei.value.replica_id == 7
+    eng.close()
+
+    def factory(seat):
+        return _make_engine(
+            tiny_engine_parts, num_slots=2, policy=ServingResiliencePolicy()
+        )
+
+    router = _make_fleet(tiny_engine_parts, 2, tmp_path, factory=factory)
+    try:
+        client = GenerationClient(router)
+        uid = client.submit([1, 2, 3], 4)
+        seat = router.replica_of(uid)
+        router.begin_drain(shed_pending=True)
+        with pytest.raises(RequestShedError) as se:
+            list(client.stream(uid))
+        assert se.value.replica_id == seat
+        assert se.value.tenant_id is not None
+    finally:
+        router.close()
+
+
+# --------------------------------------------------------------- N=1 parity
+
+
+def test_fleet_of_one_matches_bare_engine(tiny_engine_parts, tmp_path):
+    """A one-replica fleet is the bare engine: same uid sequence (seat 0
+    counts from 0), same greedy tokens, same finish reasons."""
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(1, 37, size=n).tolist() for n in (4, 6, 5, 8)]
+    bare = _make_engine(tiny_engine_parts, num_slots=3)
+    uids_b = [bare.submit(p, 6) for p in prompts]
+    done_b = bare.run(uids_b)
+    bare.close()  # the soak asserts a clean serving/* namespace at the end
+
+    router = _make_fleet(
+        tiny_engine_parts, 1, tmp_path,
+        factory=lambda seat: _make_engine(tiny_engine_parts, num_slots=3),
+    )
+    try:
+        uids_f = [router.submit(p, 6) for p in prompts]
+        assert uids_f == uids_b  # identical uid sequence, not just disjoint
+        done_f = router.run(uids_f)
+    finally:
+        router.close()
+    for prompt, ub, uf in zip(prompts, uids_b, uids_f):
+        assert done_b[ub].finish_reason == done_f[uf].finish_reason
+        _assert_greedy_equivalent(
+            tiny_engine_parts, prompt, done_b[ub].generated, done_f[uf].generated
+        )
+
+
+# --------------------------------------------------------------- chaos soak
+
+
+def _soak_registry():
+    reg = TenantRegistry(class_ttl_s={0: 8.0, 1: 16.0})
+    reg.register("free1", slo_class=0, kv_block_quota=6)
+    reg.register("free2", slo_class=0, kv_block_quota=6)
+    reg.register("pro1", slo_class=1)
+    reg.register("pro2", slo_class=1)
+    return reg
+
+
+def _soak_traffic():
+    return [
+        TenantTraffic("free1", num_requests=12, arrivals_per_round=2.0,
+                      prompt_len=(4, 10), max_new=(4, 8), vocab=37),
+        TenantTraffic("free2", num_requests=12, arrivals_per_round=2.0,
+                      prompt_len=(4, 10), max_new=(4, 8), vocab=37),
+        TenantTraffic("pro1", num_requests=6, arrivals_per_round=0.5,
+                      prompt_len=(4, 10), max_new=(4, 8), vocab=37,
+                      shared_prefix=4),
+        TenantTraffic("pro2", num_requests=6, arrivals_per_round=0.5,
+                      prompt_len=(6, 12), max_new=(4, 8), vocab=37,
+                      shared_prefix=4),
+    ]
+
+
+def test_fleet_chaos_soak_exactly_once_and_slo(tiny_engine_parts, tmp_path):
+    """The acceptance soak: 3 replicas, 4 tenants / 2 SLO classes, a hard
+    replica kill AND an in-replica crash restart AND chaos mis-routes, with
+    the autoscaler live so the idle tail triggers a graceful drain mid-run.
+    Every uid reaches exactly one terminal state, per-class p99 ordering
+    holds fleet-wide, zero quota violations, and affinity beats random."""
+    model, params, _ = tiny_engine_parts
+    reg = _soak_registry()
+    policy = ServingResiliencePolicy(
+        max_pending=16, high_watermark=1.0, low_watermark=0.5, preemption=True,
+    )
+
+    def factory(seat):
+        return ServingEngine(
+            model, params, num_slots=3, max_seq_len=32, block_size=4,
+            num_blocks=20, eos_token_id=None, pad_token_id=0,
+            gen_kwargs=dict(do_sample=False), seed=seat, policy=policy,
+            prefix_caching=True, tenants=reg,
+        )
+
+    report = run_fleet_scenario(
+        factory, reg, _soak_traffic(), num_replicas=3,
+        chaos_spec="fleet-replica-kill:1,fleet-route:2,serving-decode:1",
+        dt_s=0.05, max_rounds=400, seed=0, wedge_timeout_s=0.25,
+        diagnostics_dir=str(tmp_path),
+        autoscale=True, min_replicas=1, max_replicas=4,
+        scale_down_occupancy=0.3, breach_rounds=3, cooldown_rounds=4,
+        idle_tail_rounds=30,
+    )
+    assert isinstance(report, FleetScenarioReport)
+    # the harness already asserted exactly-once accounting; re-check the
+    # externally visible facts
+    assert report.submitted == 36 and report.rejected == 0
+    assert len(report.terminal) == 36
+    assert set(report.terminal.values()) <= TERMINAL_REASONS
+    assert report.replica_kills >= 1, "chaos never killed a replica"
+    assert report.reroutes >= 1, "the kill re-routed nothing"
+    assert report.restarts >= 1, "chaos never forced a supervised restart"
+    assert "drain" in [a for _, a in report.autoscale_events], (
+        f"the idle tail never triggered an autoscale drain: "
+        f"{report.autoscale_events}"
+    )
+    assert report.quota_violations == 0
+    assert report.p99_ordering_ok(), (
+        f"higher SLO class saw worse p99 fleet-wide: {report.p99_by_class}"
+    )
+    assert report.affinity_hit_rate > report.random_hit_rate
+    assert report.replicas_peak >= 3 and report.replicas_final < 3
+    assert 0.0 < report.fairness_jain <= 1.0
+    # fleet gauges snapshotted before close agree with the ledger
+    assert report.gauges["fleet/replica_kills"] == float(report.replica_kills)
+    assert report.gauges["fleet/reroutes"] == float(report.reroutes)
+    assert report.gauges["fleet/autoscale/drain"] >= 1.0
+    assert report.gauges["fleet/finished"] == 36.0
+    # everything was cleared by router.close() at the end
+    assert gauges.snapshot(prefix="serving/") == {}
+    assert gauges.snapshot(prefix="fleet/") == {}
+
+
+def test_fleet_scenario_without_chaos_is_clean(tiny_engine_parts, tmp_path):
+    """No chaos, light traffic, autoscale off: nothing kills, restarts or
+    sheds; everyone finishes; the fleet ends at its starting size."""
+    model, params, _ = tiny_engine_parts
+    reg = TenantRegistry()
+    reg.register("a", slo_class=0)
+    reg.register("b", slo_class=1)
+
+    def factory(seat):
+        return ServingEngine(
+            model, params, num_slots=3, max_seq_len=32, block_size=4,
+            eos_token_id=None, pad_token_id=0, gen_kwargs=dict(do_sample=False),
+            seed=seat, prefix_caching=False, tenants=reg,
+        )
+
+    traffic = [
+        TenantTraffic("a", num_requests=5, arrivals_per_round=1.0,
+                      prompt_len=(4, 8), max_new=(4, 6), vocab=37),
+        TenantTraffic("b", num_requests=5, arrivals_per_round=1.0,
+                      prompt_len=(4, 8), max_new=(4, 6), vocab=37),
+    ]
+    report = run_fleet_scenario(
+        factory, reg, traffic, num_replicas=2, autoscale=False,
+        dt_s=0.05, max_rounds=200, diagnostics_dir=str(tmp_path),
+    )
+    assert report.restarts == 0 and report.replica_kills == 0
+    assert report.quota_violations == 0
+    assert sorted(report.terminal.values()) == [FINISH_LENGTH] * 10
+    assert report.replicas_final == 2 and report.autoscale_events == []
+    assert report.fairness_jain > 0.9
+
+
+# ------------------------------------------------------------------- config
+
+
+def test_train_config_parses_serving_fleet_block():
+    from trlx_tpu.data.configs import ServingFleetConfig, TrainConfig
+
+    cfg = TrainConfig.from_dict(dict(
+        total_steps=1, batch_size=1, checkpoint_dir="/tmp/x",
+        serving_fleet=dict(
+            enabled=True, num_replicas=3, prefix_weight=2.0, autoscale=True,
+            min_replicas=2, max_replicas=5, breach_rounds=4,
+        ),
+    ))
+    svf = cfg.serving_fleet
+    assert isinstance(svf, ServingFleetConfig)
+    assert svf.enabled and svf.num_replicas == 3 and svf.prefix_weight == 2.0
+    assert svf.autoscale and svf.min_replicas == 2 and svf.max_replicas == 5
+    # default stays off: the fleet is opt-in
+    assert TrainConfig.from_dict(dict(
+        total_steps=1, batch_size=1, checkpoint_dir="/tmp/x",
+    )).serving_fleet.enabled is False
+    with pytest.raises(ValueError, match="num_replicas"):
+        ServingFleetConfig(num_replicas=0)
+    with pytest.raises(ValueError, match="min_replicas"):
+        ServingFleetConfig(min_replicas=4, max_replicas=2)

@@ -691,6 +691,72 @@ class ServingTenancyConfig:
 
 
 @dataclass
+class ServingFleetConfig:
+    """Serving fleet: N supervised engine replicas behind a prefix-affinity
+    router with a gauge-driven autoscaler (``trlx_tpu/fleet/``;
+    docs/serving.md "Fleet serving"). Only meaningful with
+    ``train.serving.enabled``; fleet replicas are always supervisor-wrapped
+    regardless of ``serving_resilience.enabled``.
+
+    Routing score per active replica =
+    ``prefix_weight * warm_prefix_blocks + tenant_weight * recent_tenant_hits
+    - load_weight * (live_slots + pending) / num_slots``; highest wins, so
+    zeroing the affinity weights degenerates to least-loaded.
+
+    :param enabled: master switch — off keeps the single-engine serving path
+        byte-identical (a fleet of one is also byte-identical, but pays the
+        router bookkeeping).
+    :param num_replicas: replicas built at startup.
+    :param prefix_weight: routing weight per warm prefix block the candidate
+        already caches for the prompt.
+    :param tenant_weight: routing weight per recent same-tenant request on
+        the candidate (stickiness).
+    :param load_weight: routing penalty per unit of normalized load (the
+        least-loaded fallback).
+    :param tenant_window: recent routing decisions per tenant feeding the
+        stickiness term.
+    :param autoscale: run the :class:`FleetAutoscaler` control loop.
+    :param min_replicas: autoscaler floor (never drains below).
+    :param max_replicas: autoscaler ceiling (never grows above).
+    :param scale_up_pending_per_slot: fleet pending depth per active slot
+        that counts as a scale-up breach.
+    :param scale_down_occupancy: instantaneous occupancy below which an
+        idle (zero-pending) fleet counts as a scale-down breach.
+    :param breach_rounds: consecutive breaches required before either
+        action (hysteresis: one hot round never scales).
+    :param cooldown_rounds: refractory rounds after any action in which no
+        further action fires (no flapping under oscillating load).
+    """
+
+    enabled: bool = False
+    num_replicas: int = 2
+    prefix_weight: float = 1.0
+    tenant_weight: float = 0.25
+    load_weight: float = 2.0
+    tenant_window: int = 32
+    autoscale: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 4
+    scale_up_pending_per_slot: float = 1.0
+    scale_down_occupancy: float = 0.25
+    breach_rounds: int = 3
+    cooldown_rounds: int = 8
+
+    def __post_init__(self):
+        if self.num_replicas < 1:
+            raise ValueError(f"num_replicas must be >= 1, got {self.num_replicas}")
+        if not (1 <= self.min_replicas <= self.max_replicas):
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"{self.min_replicas}..{self.max_replicas}"
+            )
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        return cls(**config)
+
+
+@dataclass
 class LearnerOverlapConfig:
     """Overlapped-collective FSDP train step (``trlx_tpu/parallel/fsdp.py``;
     docs/parallelism.md "Learner overlap & FSDP").
@@ -823,6 +889,13 @@ class TrainConfig:
         default_factory=lambda: ServingTenancyConfig()
     )
 
+    # Serving fleet (prefix-affinity router over N supervised replicas /
+    # gauge-driven autoscaler / fleet-wide SLO ledger) — see
+    # ServingFleetConfig and docs/serving.md "Fleet serving".
+    serving_fleet: "ServingFleetConfig" = field(
+        default_factory=lambda: ServingFleetConfig()
+    )
+
     # Overlapped-collective FSDP learner (shard_map allgather/reduce-scatter
     # schedule + ZeRO-sharded optimizer state) — see LearnerOverlapConfig and
     # docs/parallelism.md "Learner overlap & FSDP".
@@ -883,6 +956,9 @@ class TrainConfig:
         svt = config.get("serving_tenancy")
         if isinstance(svt, dict):
             config["serving_tenancy"] = ServingTenancyConfig.from_dict(svt)
+        svf = config.get("serving_fleet")
+        if isinstance(svf, dict):
+            config["serving_fleet"] = ServingFleetConfig.from_dict(svf)
         lov = config.get("learner_overlap")
         if isinstance(lov, dict):
             config["learner_overlap"] = LearnerOverlapConfig.from_dict(lov)

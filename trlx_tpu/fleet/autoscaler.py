@@ -1,0 +1,133 @@
+"""FleetAutoscaler: gauge-driven replica scaling with hysteresis.
+
+The router makes N replicas one serving surface; the autoscaler decides what
+N should be. It is deliberately *gauge-driven*: its only inputs are the
+per-replica gauges the engines already export
+(``serving/replica/<seat>/pending_depth``, ``.../live_slots``) — the
+same numbers an operator's dashboard shows — so a scaling decision is always
+explainable from the observability surface, and the obs pipeline itself gets
+exercised by the control loop (a replica whose gauges stop updating reads as
+idle and is drained, which is the correct response to a zombie).
+
+Scaling policy (docs/serving.md "Fleet serving"):
+
+- **Up**: fleet pending depth per active slot above
+  ``scale_up_pending_per_slot`` for ``breach_rounds`` consecutive
+  observations → :meth:`FleetRouter.add_replica`. Pending-per-slot is the
+  pressure signal the shedding watermarks key off, one level up: queue
+  growth the existing replicas cannot absorb.
+- **Down**: zero pending AND mean slot occupancy below
+  ``scale_down_occupancy`` for ``breach_rounds`` consecutive observations →
+  :meth:`FleetRouter.begin_decommission` of the least-loaded active replica
+  (graceful: its queued + live work finishes where it was accepted).
+- **Hysteresis**: both directions require ``breach_rounds`` consecutive
+  breaches (one hot round never scales), and every action starts a
+  ``cooldown_rounds`` refractory window in which no further action fires —
+  oscillating load cannot flap the fleet (the no-flap test's contract).
+
+``observe()`` is called once per fleet round, after
+:meth:`FleetRouter.export_gauges`, on the driving thread.
+"""
+
+from typing import List, Tuple
+
+from trlx_tpu.fleet.router import FleetRouter
+from trlx_tpu.utils import logging
+from trlx_tpu.utils.metrics import gauges
+
+logger = logging.get_logger(__name__)
+
+
+class FleetAutoscaler:
+    def __init__(
+        self,
+        router: FleetRouter,
+        *,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        scale_up_pending_per_slot: float = 1.0,
+        scale_down_occupancy: float = 0.25,
+        breach_rounds: int = 3,
+        cooldown_rounds: int = 8,
+    ):
+        if not (1 <= min_replicas <= max_replicas):
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"{min_replicas}..{max_replicas}"
+            )
+        if breach_rounds < 1 or cooldown_rounds < 0:
+            raise ValueError(
+                f"breach_rounds must be >= 1 (got {breach_rounds}), "
+                f"cooldown_rounds >= 0 (got {cooldown_rounds})"
+            )
+        self.router = router
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.scale_up_pending_per_slot = float(scale_up_pending_per_slot)
+        self.scale_down_occupancy = float(scale_down_occupancy)
+        self.breach_rounds = int(breach_rounds)
+        self.cooldown_rounds = int(cooldown_rounds)
+        self._up_streak = 0
+        self._down_streak = 0
+        self._cooldown = 0
+        self._round = 0
+        #: (round, action) history — ``fleet_autoscale_events`` in bench
+        self.events: List[Tuple[int, str]] = []
+
+    def observe(self) -> None:
+        """One control-loop tick: read the per-replica gauges, update the
+        breach streaks, maybe act. Single-driver (the fleet round loop)."""
+        self._round += 1
+        actives = self.router._active_handles()
+        if not actives:
+            return
+        pending = 0.0
+        live = 0.0
+        slots = 0
+        for h in actives:
+            prefix = f"serving/replica/{h.seat}/"
+            pending += gauges.get(prefix + "pending_depth")
+            live += gauges.get(prefix + "live_slots")
+            slots += h.supervisor.num_slots
+        pressure = pending / max(1, slots)
+        # instantaneous occupancy (live_slots gauge, not the lifetime-mean
+        # slot_occupancy): scale-down must see idleness now, not averaged
+        # over the busy history
+        mean_occupancy = live / max(1, slots)
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            # streaks reset during cooldown: the refractory window demands
+            # fresh consecutive evidence before the next action
+            self._up_streak = 0
+            self._down_streak = 0
+            return
+        if pressure > self.scale_up_pending_per_slot:
+            self._up_streak += 1
+            self._down_streak = 0
+        elif pending == 0.0 and mean_occupancy < self.scale_down_occupancy:
+            self._down_streak += 1
+            self._up_streak = 0
+        else:
+            self._up_streak = 0
+            self._down_streak = 0
+        if self._up_streak >= self.breach_rounds and len(actives) < self.max_replicas:
+            logger.info(
+                f"fleet autoscale up: pending/slot {pressure:.2f} > "
+                f"{self.scale_up_pending_per_slot} for {self._up_streak} rounds"
+            )
+            self.router.add_replica()
+            self.router.ledger.note_scale_up()
+            self.events.append((self._round, "up"))
+            self._cooldown = self.cooldown_rounds
+            self._up_streak = 0
+        elif self._down_streak >= self.breach_rounds and len(actives) > self.min_replicas:
+            victim = max(actives, key=lambda h: (-h.load, h.seat))
+            logger.info(
+                f"fleet autoscale drain: idle (occupancy {mean_occupancy:.2f} < "
+                f"{self.scale_down_occupancy}) for {self._down_streak} rounds — "
+                f"draining seat {victim.seat}"
+            )
+            self.router.begin_decommission(victim)
+            self.events.append((self._round, "drain"))
+            self._cooldown = self.cooldown_rounds
+            self._down_streak = 0

@@ -43,7 +43,15 @@ of ``site:arg`` tokens:
 - ``broadcast-chunk:N`` — the next ``N`` chunked-broadcast layer installs
   raise mid-broadcast (exercises the torn-version guarantee: the committed
   snapshot must stay the previous version, the burned version number must
-  stay monotonic, and a re-publish must recover).
+  stay monotonic, and a re-publish must recover);
+- ``fleet-route:N`` — the next ``N`` fleet routing decisions deliberately
+  pick the WORST-scoring replica instead of the best (exercises the
+  guarantee that routing quality is performance-only: mis-routed requests
+  still finish exactly once, only affinity hit rates suffer);
+- ``fleet-replica-kill:N`` — the fleet router hard-kills its busiest live
+  replica ``N`` times (exercises cross-replica re-route: the dead replica's
+  host-side request state is adopted by a survivor and every uid still
+  reaches exactly one terminal state).
 
 Count-based sites are *budgets*: each injected fault decrements the budget, so
 ``reward:2`` means exactly two failures then clean behavior — which is exactly
@@ -80,6 +88,8 @@ _COUNT_SITES = (
     "serving-alloc",
     "serving-wedge",
     "broadcast-chunk",
+    "fleet-route",
+    "fleet-replica-kill",
 )
 
 

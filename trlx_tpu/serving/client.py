@@ -74,6 +74,15 @@ class GenerationClient:
             raise KeyError(f"unknown request uid {uid}")
         return req
 
+    def _replica_of(self, uid: int) -> Optional[int]:
+        """Replica attribution for typed errors: a fleet router knows which
+        replica served the uid (``replica_of``); a bare engine/supervisor is
+        its own replica (``replica_id``, None outside a fleet)."""
+        fn = getattr(self.engine, "replica_of", None)
+        if fn is not None:
+            return fn(uid)
+        return getattr(self.engine, "replica_id", None)
+
     def stream(self, uid: int) -> Iterator[int]:
         """Yield the request's tokens as the engine produces them, driving
         engine rounds while the request is live. Tokens already decoded when
@@ -104,6 +113,7 @@ class GenerationClient:
                             f"engine drained with request uid={uid} unaccounted "
                             f"({sent} tokens streamed)",
                             tenant_id=req.tenant_id, slo_class=req.slo_class,
+                            replica_id=self._replica_of(uid),
                         )
         for tok in req.generated[sent:]:
             yield tok
@@ -114,12 +124,14 @@ class GenerationClient:
             raise RequestShedError(
                 f"request uid={uid} was shed after {len(req.generated)} tokens",
                 tenant_id=req.tenant_id, slo_class=req.slo_class,
+                replica_id=self._replica_of(uid),
             )
         if req.finish_reason == FINISH_DEADLINE:
             raise RequestExpiredError(
                 f"request uid={uid} expired (deadline_s={req.deadline_s}) "
                 f"after {len(req.generated)} tokens",
                 tenant_id=req.tenant_id, slo_class=req.slo_class,
+                replica_id=self._replica_of(uid),
             )
 
     # -- rollout path --------------------------------------------------------
@@ -167,12 +179,14 @@ class GenerationClient:
                     raise RequestShedError(
                         f"batch member uid={uid} was shed",
                         tenant_id=req.tenant_id, slo_class=req.slo_class,
+                        replica_id=self._replica_of(uid),
                     )
                 if req.finish_reason == FINISH_DEADLINE:
                     raise RequestExpiredError(
                         f"batch member uid={uid} expired "
                         f"(deadline_s={req.deadline_s})",
                         tenant_id=req.tenant_id, slo_class=req.slo_class,
+                        replica_id=self._replica_of(uid),
                     )
             p = np.asarray(p, np.int32)
             gen = np.asarray(req.generated, np.int32)

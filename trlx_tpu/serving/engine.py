@@ -176,6 +176,8 @@ class ServingEngine:
         spec_ngram: int = 3,
         prefill_chunk: int = 0,
         tenants: Optional[TenantRegistry] = None,
+        gauge_prefix: str = "serving/",
+        replica_id: Optional[int] = None,
     ):
         """``trunk`` is a built ``TransformerLM`` (its config decides the KV
         dtype via ``kv_cache_quant`` and the kernel via
@@ -186,7 +188,14 @@ class ServingEngine:
         identical). ``spec_ngram`` caps the draft-match n-gram order.
         ``prefill_chunk`` > 0 splits admissions longer than the chunk into
         per-round ``paged_verify`` appends interleaved with decode (0 =
-        whole-prompt bucketed prefill)."""
+        whole-prompt bucketed prefill).
+
+        ``gauge_prefix`` namespaces every gauge this engine writes (and the
+        prefix ``close()`` clears). The default keeps the historical global
+        ``serving/*`` keys; the fleet router gives each replica
+        ``serving/replica/<i>/`` so N live engines stop clobbering each
+        other. ``replica_id`` tags typed errors with the raising replica
+        (None outside a fleet)."""
         c = trunk.config
         if c.stacked:
             raise NotImplementedError("serving engine: per-layer list layout only")
@@ -211,6 +220,8 @@ class ServingEngine:
         self.spec_k = int(spec_k)
         self.spec_ngram = int(spec_ngram)
         self.prefill_chunk = int(prefill_chunk)
+        self.gauge_prefix = str(gauge_prefix)
+        self.replica_id = None if replica_id is None else int(replica_id)
         if self.spec_k < 0 or self.spec_ngram < 1 or self.prefill_chunk < 0:
             raise ValueError(
                 f"spec_k={spec_k} must be >= 0, spec_ngram={spec_ngram} >= 1, "
@@ -433,6 +444,7 @@ class ServingEngine:
                 "engine is draining: new requests are rejected (graceful shutdown)",
                 tenant_id=spec.tenant_id if spec else None,
                 slo_class=spec.slo_class if spec else None,
+                replica_id=self.replica_id,
             )
         if len(prompt) + max_new_tokens > self.max_seq_len:
             raise ValueError(
@@ -450,6 +462,7 @@ class ServingEngine:
                 f"holds {self.num_blocks - 1}: it can never be admitted",
                 tenant_id=spec.tenant_id if spec else None,
                 slo_class=spec.slo_class if spec else None,
+                replica_id=self.replica_id,
             )
         if spec is not None and spec.kv_block_quota and worst > spec.kv_block_quota:
             # same never-admittable logic against the tenant's own cap — and
@@ -461,6 +474,7 @@ class ServingEngine:
                 f"can never be admitted",
                 tenant_id=spec.tenant_id,
                 slo_class=spec.slo_class,
+                replica_id=self.replica_id,
             )
         return self.scheduler.submit(
             prompt, max_new_tokens, eos_token_id=self.eos_token_id,
@@ -918,7 +932,7 @@ class ServingEngine:
             for req in finished:
                 self.stats.finished_requests += 1
                 if req.latency_s is not None:
-                    gauges.observe("serving/request_latency_s", req.latency_s)
+                    gauges.observe(self.gauge_prefix + "request_latency_s", req.latency_s)
                     if self.tenants is not None:
                         self._tenant_latency.setdefault(
                             req.tenant_id, deque(maxlen=512)
@@ -1041,49 +1055,55 @@ class ServingEngine:
 
     def export_gauges(self) -> None:
         s = self.summary()
-        gauges.set("serving/slot_occupancy", s["mean_slot_occupancy"])
-        gauges.set("serving/prefix_cache_hit_rate", s["prefix_cache_hit_rate"])
-        gauges.set("serving/blocks_in_use", s["blocks_in_use"])
-        gauges.set("serving/delivered_tokens", s["delivered_tokens"])
-        gauges.set("serving/finished_requests", s["finished_requests"])
-        gauges.set("serving/pending_depth", s["pending_depth"])
-        gauges.set("serving/accepted_tok_per_round", s["accepted_tok_per_round"])
-        gauges.set("serving/spec_accept_rate", s["spec_accept_rate"])
-        gauges.set("serving/overlap_fraction", s["overlap_fraction"])
-        gauges.set("serving/shed", s["shed"])
-        gauges.set("serving/expired", s["expired"])
-        gauges.set("serving/preempted", s["preempted"])
+        gp = self.gauge_prefix
+        gauges.set(gp + "slot_occupancy", s["mean_slot_occupancy"])
+        gauges.set(gp + "prefix_cache_hit_rate", s["prefix_cache_hit_rate"])
+        gauges.set(gp + "blocks_in_use", s["blocks_in_use"])
+        gauges.set(gp + "delivered_tokens", s["delivered_tokens"])
+        gauges.set(gp + "finished_requests", s["finished_requests"])
+        gauges.set(gp + "pending_depth", s["pending_depth"])
+        # instantaneous live-slot count (slot_occupancy above is a lifetime
+        # mean): the fleet autoscaler's scale-down signal must see idleness
+        # NOW, not averaged over the whole busy history
+        gauges.set(gp + "live_slots", float(self.scheduler.live_slots))
+        gauges.set(gp + "accepted_tok_per_round", s["accepted_tok_per_round"])
+        gauges.set(gp + "spec_accept_rate", s["spec_accept_rate"])
+        gauges.set(gp + "overlap_fraction", s["overlap_fraction"])
+        gauges.set(gp + "shed", s["shed"])
+        gauges.set(gp + "expired", s["expired"])
+        gauges.set(gp + "preempted", s["preempted"])
         if self.tenants is None:
             return
-        # per-tenant / per-SLO-class breakdowns (satellite: serving/tenant/*
-        # and serving/class/* ride the same registry; ServingEngine.close()
-        # clears the whole serving/ prefix)
+        # per-tenant / per-SLO-class breakdowns (satellite: <prefix>tenant/*
+        # and <prefix>class/* ride the same registry; ServingEngine.close()
+        # clears the whole gauge prefix)
         tenant_counts = self.scheduler.tenant_outcome_counts()
         # zero-fill every registered tenant so dashboards see stable keys
         # even before a tenant's first shed/expiry/preemption
         for tid in set(self.tenants.tenant_ids()) | set(tenant_counts):
             counts = tenant_counts.get(tid, {})
             for key in ("shed", "expired", "preempted"):
-                gauges.set(f"serving/tenant/{tid}/{key}", float(counts.get(key, 0)))
+                gauges.set(f"{gp}tenant/{tid}/{key}", float(counts.get(key, 0)))
         for cls, counts in self.scheduler.class_outcome_counts().items():
             for key in ("shed", "expired", "preempted"):
-                gauges.set(f"serving/class/{cls}/{key}", float(counts.get(key, 0)))
+                gauges.set(f"{gp}class/{cls}/{key}", float(counts.get(key, 0)))
         with self._lock:
             tenant_lat = {t: list(w) for t, w in self._tenant_latency.items()}
             class_lat = {c: list(w) for c, w in self._class_latency.items()}
         for tid, window in tenant_lat.items():
-            gauges.set(f"serving/tenant/{tid}/p99_latency_s", self._p99(window))
+            gauges.set(f"{gp}tenant/{tid}/p99_latency_s", self._p99(window))
         for cls, window in class_lat.items():
-            gauges.set(f"serving/class/{cls}/p99_latency_s", self._p99(window))
+            gauges.set(f"{gp}class/{cls}/p99_latency_s", self._p99(window))
         for tid, used in self.allocator.owner_census().items():
             if tid is not None:
-                gauges.set(f"serving/tenant/{tid}/blocks_in_use", float(used))
+                gauges.set(f"{gp}tenant/{tid}/blocks_in_use", float(used))
 
     def close(self) -> None:
         """Retire this engine's observability surface: clear every gauge
-        under the serving/ prefix (GaugeRegistry.clear is prefix-aware), so
-        a later engine in the same process starts from a clean slate.
-        Callers that want final values snapshot them BEFORE close — the
-        supervisor deliberately does not call this, its tests read gauges
-        after shutdown."""
-        gauges.clear(prefix="serving/")
+        under this engine's gauge prefix (GaugeRegistry.clear is
+        prefix-aware), so a later engine in the same process — or the other
+        replicas of a fleet — start from / keep a clean slate. Callers that
+        want final values snapshot them BEFORE close — the supervisor
+        deliberately does not call this, its tests read gauges after
+        shutdown."""
+        gauges.clear(prefix=self.gauge_prefix)

@@ -29,17 +29,24 @@ class _TenantTagged:
     a multi-tenant deployment attribute failures (which tenant's request was
     shed/expired, at what SLO class) straight off the exception instead of
     re-looking the request up. Both fields are None on the default-tenant
-    path — constructing with a bare message stays source-compatible."""
+    path — constructing with a bare message stays source-compatible.
+
+    ``replica_id`` identifies which fleet replica raised (None outside a
+    fleet): fleet-level retry logic distinguishes engine-fatal outcomes
+    (re-route the request away from that replica) from request-fatal ones
+    (the request itself is shed/expired — retrying elsewhere won't help)."""
 
     def __init__(
         self,
         *args,
         tenant_id: Optional[str] = None,
         slo_class: Optional[int] = None,
+        replica_id: Optional[int] = None,
     ):
         super().__init__(*args)
         self.tenant_id = tenant_id
         self.slo_class = slo_class
+        self.replica_id = replica_id
 
 
 class RequestTooLarge(_TenantTagged, ValueError):

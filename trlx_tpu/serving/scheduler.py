@@ -572,8 +572,13 @@ class InflightScheduler:
         uid must never be reissued), and outcome counters stay cumulative
         across engine generations."""
         with self._lock:
-            self._uid = itertools.count(state["uid_hwm"])
-            self.uid_hwm = state["uid_hwm"]
+            # resume from the max of both watermarks: a successor that was
+            # already seated at a fleet uid base (seat_uid_base) — or that
+            # adopted another replica's state before this one — must never
+            # rewind below its own high-water mark
+            start = max(self.uid_hwm, state["uid_hwm"])
+            self._uid = itertools.count(start)
+            self.uid_hwm = start
             self.requests.update(state["requests"])
             self.finished.update(state["finished"])
             self._cancelled |= state["cancelled"]
@@ -594,6 +599,18 @@ class InflightScheduler:
                 c = self.class_counts.setdefault(cls, {})
                 for key, n in counts.items():
                     c[key] = c.get(key, 0) + n
+
+    def seat_uid_base(self, base: int) -> None:
+        """Seat the uid counter at (at least) ``base``. The fleet router
+        gives each replica a disjoint uid block so requests routed to
+        different engines can never collide — and a request re-routed onto a
+        survivor after a replica death keeps its original uid (adopt_state's
+        max() respects an already-seated base). Idempotent: seating below
+        the current watermark is a no-op."""
+        with self._lock:
+            start = max(self.uid_hwm, int(base))
+            self._uid = itertools.count(start)
+            self.uid_hwm = start
 
     def note_step(self) -> None:
         # locked: the occupancy gauge (bench/obs threads) reads these counters

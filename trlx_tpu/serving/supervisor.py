@@ -132,6 +132,18 @@ class ServingSupervisor:
     def num_blocks(self) -> int:
         return self.engine.num_blocks
 
+    @property
+    def gauge_prefix(self) -> str:
+        return self.engine.gauge_prefix
+
+    @property
+    def replica_id(self):
+        return self.engine.replica_id
+
+    @property
+    def num_slots(self) -> int:
+        return self.engine.num_slots
+
     def submit(self, *args, **kwargs) -> int:
         return self.engine.submit(*args, **kwargs)
 
@@ -172,10 +184,11 @@ class ServingSupervisor:
         return out
 
     def export_gauges(self) -> None:
-        self.engine.export_gauges()
+        engine = self.engine
+        engine.export_gauges()
         with self._lock:
             n = self.restarts
-        gauges.set("serving/restarts", float(n))
+        gauges.set(engine.gauge_prefix + "restarts", float(n))
 
     def close(self) -> None:
         """Unregister the watchdog escalation (a retired supervisor must not
@@ -211,7 +224,7 @@ class ServingSupervisor:
             params = self._params
             island = self._island
             draining = self._draining
-        gauges.set("serving/restarts", float(n))
+        gauges.set(old.gauge_prefix + "restarts", float(n))
         if n > self.max_restarts:
             from trlx_tpu.resilience.health import write_diagnostics_bundle
 
@@ -302,12 +315,21 @@ class ServingSupervisor:
             self.export_gauges()
         return done
 
+    def begin_drain(self, shed_pending: bool = True) -> None:
+        """Enter drain mode without driving it to completion: reject new
+        submits (restarted generations stay draining too). The fleet
+        autoscaler decommissions a replica this way — it keeps stepping the
+        fleet as a whole while the drained replica's live slots finish.
+        ``shed_pending=False`` lets queued requests finish instead of
+        shedding them (graceful decommission re-prefills nothing)."""
+        with self._lock:
+            self._draining = True
+        self.engine.begin_drain(shed_pending=shed_pending)
+
     def drain(self) -> Dict[int, Request]:
         """Supervised graceful shutdown: shed pending, finish live slots —
         restarting through crashes so accepted live requests still finish."""
-        with self._lock:
-            self._draining = True
-        self.engine.begin_drain()
+        self.begin_drain()
         done: Dict[int, Request] = dict(self.scheduler.pop_finished())
         while self.scheduler.has_work:
             self.step()

@@ -107,6 +107,7 @@ class PPOTrainer(MeshRLTrainer):
         # decode/reward/scoring/quarantine downstream are identical.
         self._serving_client = None
         self._serving_engine = None
+        self._serving_autoscaler = None
         self._serving_max_new = 0
         self._serving_min_new = 0
         self._serving_param_ref = None
@@ -632,7 +633,10 @@ class PPOTrainer(MeshRLTrainer):
         # aging policy) survive supervised restarts by construction
         tenants = svt.build_registry() if svt.enabled else None
 
-        def build_engine():
+        def build_engine(replica_seat=0):
+            # each fleet seat samples from its own rng stream (seed offset by
+            # the seat); seat 0 keeps the single-engine seed so a one-replica
+            # fleet is byte-identical to the bare engine
             return ServingEngine(
                 TransformerLM(trunk_config),
                 None,  # snapshot installed per rollout phase in _serving_generate
@@ -645,7 +649,7 @@ class PPOTrainer(MeshRLTrainer):
                 gen_kwargs=gen_kwargs,
                 min_new_tokens=self._serving_min_new,
                 prefix_caching=cfg.prefix_caching,
-                seed=self.config.train.seed + 17,
+                seed=self.config.train.seed + 17 + replica_seat,
                 policy=policy,
                 spec_k=cfg.spec_k,
                 spec_ngram=cfg.spec_ngram,
@@ -653,7 +657,37 @@ class PPOTrainer(MeshRLTrainer):
                 tenants=tenants,
             )
 
-        if svr.enabled:
+        svf = self.config.train.serving_fleet
+        if svf.enabled:
+            # fleet mode: N supervised replicas behind the prefix-affinity
+            # router (docs/serving.md "Fleet serving"); replicas are always
+            # supervisor-wrapped — re-route on replica death rides the
+            # supervisor's export/adopt replay seam
+            from trlx_tpu.fleet import FleetAutoscaler, fleet_factory
+
+            diag = svr.diagnostics_dir or os.path.join(
+                self.config.train.checkpoint_dir, "diagnostics"
+            )
+            self._serving_engine = fleet_factory(
+                build_engine,
+                svf,
+                max_restarts=svr.max_restarts,
+                backoff_base_s=svr.restart_backoff_base_s,
+                backoff_max_s=svr.restart_backoff_max_s,
+                wedge_timeout_s=svr.wedge_timeout_s,
+                diagnostics_dir=diag,
+            )
+            if svf.autoscale:
+                self._serving_autoscaler = FleetAutoscaler(
+                    self._serving_engine,
+                    min_replicas=svf.min_replicas,
+                    max_replicas=svf.max_replicas,
+                    scale_up_pending_per_slot=svf.scale_up_pending_per_slot,
+                    scale_down_occupancy=svf.scale_down_occupancy,
+                    breach_rounds=svf.breach_rounds,
+                    cooldown_rounds=svf.cooldown_rounds,
+                )
+        elif svr.enabled:
             # supervised: crashes/wedges rebuild the engine (same factory
             # args) and replay every accepted request — docs/serving.md
             diag = svr.diagnostics_dir or os.path.join(
@@ -675,7 +709,8 @@ class PPOTrainer(MeshRLTrainer):
             f"block_size={cfg.block_size}, blocks={self._serving_engine.num_blocks}, "
             f"int8_kv={trunk_config.kv_cache_quant}, impl={cfg.attention_impl}, "
             f"resilience={'on' if svr.enabled else 'off'}, "
-            f"tenancy={'on' if svt.enabled else 'off'}"
+            f"tenancy={'on' if svt.enabled else 'off'}, "
+            f"fleet={svf.num_replicas if svf.enabled else 'off'}"
         )
 
     def _serving_generate(self, prompts, params=None):
@@ -693,7 +728,10 @@ class PPOTrainer(MeshRLTrainer):
             self._serving_engine.set_params(tparams)
             self._serving_param_ref = tparams
         with self.obs.span("generate"):
-            return self._serving_client.generate_batch(prompts, self._serving_max_new)
+            out = self._serving_client.generate_batch(prompts, self._serving_max_new)
+        if self._serving_autoscaler is not None:
+            self._serving_autoscaler.observe()
+        return out
 
     # --------------------------------------------------- stream-overlapped PPO
 
@@ -994,6 +1032,8 @@ class PPOTrainer(MeshRLTrainer):
         eng = self._serving_engine
         eng.note_overlap(window.decode_busy_s, window.overlapped_s)
         eng.export_gauges()
+        if self._serving_autoscaler is not None:
+            self._serving_autoscaler.observe()
 
     # ------------------------------------------------------------- experience
 
@@ -1559,6 +1599,7 @@ class PPOTrainer(MeshRLTrainer):
             out.update(gauges.snapshot("rollout/"))
         if self._serving_client is not None:
             out.update(gauges.snapshot("serving/"))
+            out.update(gauges.snapshot("fleet/"))
         return out
 
     def post_backward_callback(self):
