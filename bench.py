@@ -741,6 +741,107 @@ def _fleet_perf(jax):
     }
 
 
+def _serving_flight_perf(jax):
+    """Request-flight telemetry leg (docs/observability.md "Request flights"):
+    the per-phase latency decomposition of the multi-tenant chaos soak, plus
+    the fleet SLO burn rate over the same terminal stream.
+
+    The flight recorder journals every request's lifecycle through the soak
+    (admissions, chunked prefill, decode rounds, preemptions, supervised
+    restarts) and reduces it to nearest-rank phase percentiles — the numbers
+    that say WHERE the tail latency of the tenants leg actually goes
+    (queue wait vs prefill vs replay tax). A FleetLedger replays the terminal
+    outcomes to report the fast-window SLO burn rate the alerting layer
+    would have seen."""
+    from trlx_tpu.fleet.ledger import FleetLedger
+    from trlx_tpu.models.presets import PRESETS
+    from trlx_tpu.models.transformer import TransformerLM
+    from trlx_tpu.obs.flight import flight
+    from trlx_tpu.serving import (
+        ServingEngine,
+        ServingResiliencePolicy,
+        TenantRegistry,
+        TenantTraffic,
+        run_scenario,
+    )
+
+    import jax.numpy as jnp
+
+    on_cpu = jax.default_backend() == "cpu"
+    base = PRESETS["gpt2"].replace(
+        compute_dtype=jnp.float32 if on_cpu else jnp.bfloat16
+    )
+    S, P, N, n_lo, n_hi = (3, 12, 8, 12, 6) if on_cpu else (16, 64, 32, 64, 32)
+    bs = 4 if on_cpu else 16
+    max_len = P + N + 4
+    blocks_per_req = -(-max_len // bs)
+
+    trunk = TransformerLM(base)
+    params = trunk.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 8), jnp.int32), jnp.ones((1, 8), jnp.int32),
+    )["params"]
+
+    reg = TenantRegistry(class_ttl_s={0: 8.0, 1: 16.0})
+    reg.register("free1", slo_class=0, kv_block_quota=blocks_per_req)
+    reg.register("free2", slo_class=0, kv_block_quota=blocks_per_req)
+    reg.register("pro1", slo_class=1)
+    reg.register("pro2", slo_class=1)
+    policy = ServingResiliencePolicy(
+        max_pending=8, high_watermark=0.75, low_watermark=0.5, preemption=True
+    )
+
+    def factory():
+        return ServingEngine(
+            trunk, params, num_slots=S, max_seq_len=max_len, block_size=bs,
+            num_blocks=1 + 2 * S * blocks_per_req // 3, eos_token_id=None,
+            pad_token_id=0, gen_kwargs=dict(do_sample=False), seed=0,
+            policy=policy, prefix_caching=True, tenants=reg,
+        )
+
+    traffic = [
+        TenantTraffic("free1", num_requests=n_lo, arrivals_per_round=2.0,
+                      prompt_len=(4, P - 2), max_new=(4, N), vocab=base.vocab_size),
+        TenantTraffic("free2", num_requests=n_lo, arrivals_per_round=2.0,
+                      prompt_len=(4, P - 2), max_new=(4, N), vocab=base.vocab_size),
+        TenantTraffic("pro1", num_requests=n_hi, arrivals_per_round=0.5,
+                      prompt_len=(4, P - 2), max_new=(4, N), vocab=base.vocab_size,
+                      shared_prefix=4),
+        TenantTraffic("pro2", num_requests=n_hi, arrivals_per_round=0.5,
+                      prompt_len=(4, P - 2), max_new=(4, N), vocab=base.vocab_size),
+    ]
+    flight.reset()
+    flight.configure(enabled=True)
+    try:
+        report = run_scenario(
+            factory, reg, traffic,
+            chaos_spec="serving-prefill:1,serving-decode:1,serving-alloc:2,serving-wedge:1",
+            dt_s=0.05, max_rounds=800, seed=7,
+            wedge_timeout_s=2.0 if not on_cpu else 0.25,
+        )
+        pct = flight.phase_percentiles()
+        # a 99%-of-terminals SLO on the soak's outcome stream: the fast-window
+        # burn rate the fleet alerting would page on (shed/expired burn budget)
+        ledger = FleetLedger(slo_target=0.99, fast_window=32, slow_window=256)
+        for uid in report.terminal:
+            ledger.record(report.requests[uid])
+        burn = ledger.burn_rates()
+        completed = len(flight.completed())
+    finally:
+        flight.configure(enabled=False)
+        flight.reset()
+    return {
+        "serving_queue_wait_p99_s": round(pct["queue_wait_p99"], 4),
+        "serving_prefill_p99_s": round(pct["prefill_p99"], 4),
+        "serving_decode_p99_s": round(pct["decode_p99"], 4),
+        "serving_preempt_replay_p99_s": round(pct["preempt_replay_p99"], 4),
+        "serving_flight_completed": int(completed),
+        "serving_flight_restarts": int(report.restarts),
+        "fleet_alert_fast_burn": round(burn["fast_burn"], 4),
+        "fleet_alert_firing": int(burn["firing"]),
+    }
+
+
 def _serving_overlap_perf(jax):
     """Stream-overlapped PPO leg (docs/serving.md "Stream-overlapped PPO"):
     how much of the decode window the streaming pipeline fills with
@@ -1410,6 +1511,10 @@ def measure():
         result.update(legs.run("fleet", lambda: _fleet_perf(jax)))
     except Exception as e:
         result["fleet_perf_error"] = f"{type(e).__name__}: {e}"[:300]
+    try:
+        result.update(legs.run("serving_flight", lambda: _serving_flight_perf(jax)))
+    except Exception as e:
+        result["serving_flight_perf_error"] = f"{type(e).__name__}: {e}"[:300]
     try:
         result.update(legs.run("serving_overlap", lambda: _serving_overlap_perf(jax)))
     except Exception as e:

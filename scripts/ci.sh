@@ -275,6 +275,28 @@ if JAX_PLATFORMS=cpu TRLX_FLEET_SEED_REGRESSION=blind_router timeout -k 10 600 \
 fi
 echo "seeded blind_router correctly rejected"
 
+echo "== request-flight telemetry tests (CPU)"
+# flight journal: nearest-rank percentile fix, per-phase decomposition
+# summing to wall latency (proved on the chaos soak with supervised
+# restarts), fleet replica-kill flight continuity, series/exporter
+# round-trips, windowed autoscaler, SLO burn-rate alerts
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_obs_flight.py -q -m "not slow" -p no:cacheprovider
+
+echo "== flight seeded-regression gate (drop_terminal must break exactly-once)"
+# the flight gate proves itself like the conc/spec/tenant gates: make the
+# recorder silently drop terminal events (TRLX_FLIGHT_SEED_REGRESSION=
+# drop_terminal) and require the exactly-once accounting test to FAIL — an
+# accounting invariant a journal that loses terminals can satisfy is not
+# being checked
+if JAX_PLATFORMS=cpu TRLX_FLIGHT_SEED_REGRESSION=drop_terminal timeout -k 10 600 \
+    python -m pytest tests/test_obs_flight.py -q -k "exactly_once" \
+    -p no:cacheprovider > /dev/null 2>&1; then
+    echo "FATAL: seeded drop_terminal regression was NOT caught by the exactly-once gate" >&2
+    exit 1
+fi
+echo "seeded drop_terminal correctly rejected"
+
 echo "== chaos soak smoke (CPU)"
 # the acceptance scenario by name: producer crashes + nan-loss + bad elements
 # + reward faults in one run, every recovery visible in gauges/summary

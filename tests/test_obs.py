@@ -62,13 +62,17 @@ def test_span_nesting_builds_dotted_paths():
     with tracer.span("learn"):
         pass
     times = tracer.drain_step_times()
-    assert set(times) == {
+    paths = {
         "time/span/rollout",
         "time/span/rollout.generate",
         "time/span/rollout.score",
         "time/span/learn",
     }
+    # every path drains its seconds plus a _n call count (per-call latency
+    # is seconds / n downstream)
+    assert set(times) == paths | {f"{p}_n" for p in paths}
     assert all(v >= 0.0 for v in times.values())
+    assert all(times[f"{p}_n"] == 1.0 for p in paths)
     # outer span includes its children
     assert times["time/span/rollout"] >= times["time/span/rollout.generate"]
     # drained: a second drain is empty
@@ -156,14 +160,16 @@ def test_gauge_histogram_percentiles():
     for v in range(1, 101):  # 1..100
         g.observe("time/step", float(v))
     stats = g.hist_stats("time/step")
-    assert stats["p50"] == 51.0  # nearest-rank over the sorted window
-    assert stats["p95"] == 96.0
+    # nearest-rank: p-th percentile of 1..100 is exactly the p-th value
+    # (ceil(q*n) ranks, 1-indexed — not the old int(q*n) one-rank-too-high)
+    assert stats["p50"] == 50.0
+    assert stats["p95"] == 95.0
     assert stats["max"] == 100.0
     assert stats["mean"] == pytest.approx(50.5)
     assert stats["count"] == 100.0
     flat = g.hist_snapshot("time/")
     assert flat == {
-        "time/step_p50": 51.0, "time/step_p95": 96.0, "time/step_max": 100.0
+        "time/step_p50": 50.0, "time/step_p95": 95.0, "time/step_max": 100.0
     }
     assert g.hist_stats("never_observed") == {}
 

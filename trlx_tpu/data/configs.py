@@ -349,6 +349,20 @@ class ObservabilityConfig:
         this long without progress. 0 disables the watchdog. Size it well
         above eval/compile pauses (first-step XLA compiles can take minutes).
     :param watchdog_poll_s: watchdog poll period; None = timeout / 4.
+    :param flight: journal per-uid request flights through the serving stack
+        (docs/observability.md "Request flights") — per-phase latency
+        decomposition, per-tenant percentile gauges, Perfetto lanes in the
+        span trace. No-op when the master switch is off.
+    :param flight_ring: completed flights retained for percentiles/trace.
+    :param flight_reservoir: newest-N completed flights kept per
+        (tenant, SLO class) for the percentile gauges.
+    :param series_capacity: points retained per gauge key in the per-step
+        time-series sampler (fixed-retention ring).
+    :param series_path: write the retained gauge time-series as JSONL here on
+        ``learn()`` exit (relative paths land under the logging dir). None
+        skips the dump.
+    :param prom_path: write the final gauge values in Prometheus text
+        exposition format here on ``learn()`` exit. None skips it.
     """
 
     enabled: bool = False
@@ -360,6 +374,12 @@ class ObservabilityConfig:
     memory_interval: int = 1
     watchdog_timeout_s: float = 0.0
     watchdog_poll_s: Optional[float] = None
+    flight: bool = True
+    flight_ring: int = 2048
+    flight_reservoir: int = 256
+    series_capacity: int = 512
+    series_path: Optional[str] = None
+    prom_path: Optional[str] = None
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):

@@ -24,18 +24,32 @@ Scaling policy (docs/serving.md "Fleet serving"):
   breaches (one hot round never scales), and every action starts a
   ``cooldown_rounds`` refractory window in which no further action fires —
   oscillating load cannot flap the fleet (the no-flap test's contract).
+- **Windowing**: each round's fleet aggregates are appended to a
+  :class:`~trlx_tpu.obs.timeseries.SeriesStore` and the decision reads the
+  newest ``window_rounds`` points with *conservative* reductions — min over
+  the window for the scale-up pressure signal, max for the scale-down
+  signals — so one spiky sample inside the window can neither trigger an
+  expansion nor hide sustained idleness. ``window_rounds=1`` (the default)
+  degenerates to the instantaneous reads and reproduces the pre-windowing
+  behavior bit-for-bit.
 
 ``observe()`` is called once per fleet round, after
 :meth:`FleetRouter.export_gauges`, on the driving thread.
 """
 
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 from trlx_tpu.fleet.router import FleetRouter
+from trlx_tpu.obs.timeseries import SeriesStore
 from trlx_tpu.utils import logging
 from trlx_tpu.utils.metrics import gauges
 
 logger = logging.get_logger(__name__)
+
+#: series keys the autoscaler maintains, one point per observe() round
+PRESSURE_KEY = "fleet/series/pending_per_slot"
+PENDING_KEY = "fleet/series/pending_depth"
+OCCUPANCY_KEY = "fleet/series/occupancy"
 
 
 class FleetAutoscaler:
@@ -49,6 +63,8 @@ class FleetAutoscaler:
         scale_down_occupancy: float = 0.25,
         breach_rounds: int = 3,
         cooldown_rounds: int = 8,
+        window_rounds: int = 1,
+        series: Optional[SeriesStore] = None,
     ):
         if not (1 <= min_replicas <= max_replicas):
             raise ValueError(
@@ -60,6 +76,8 @@ class FleetAutoscaler:
                 f"breach_rounds must be >= 1 (got {breach_rounds}), "
                 f"cooldown_rounds >= 0 (got {cooldown_rounds})"
             )
+        if window_rounds < 1:
+            raise ValueError(f"window_rounds must be >= 1, got {window_rounds}")
         self.router = router
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
@@ -67,6 +85,14 @@ class FleetAutoscaler:
         self.scale_down_occupancy = float(scale_down_occupancy)
         self.breach_rounds = int(breach_rounds)
         self.cooldown_rounds = int(cooldown_rounds)
+        self.window_rounds = int(window_rounds)
+        # retention only needs to cover the decision window (plus slack for
+        # post-hoc inspection); an external store may be shared with exporters
+        self.series = (
+            series
+            if series is not None
+            else SeriesStore(capacity=max(64, 4 * self.window_rounds))
+        )
         self._up_streak = 0
         self._down_streak = 0
         self._cooldown = 0
@@ -89,11 +115,20 @@ class FleetAutoscaler:
             pending += gauges.get(prefix + "pending_depth")
             live += gauges.get(prefix + "live_slots")
             slots += h.supervisor.num_slots
-        pressure = pending / max(1, slots)
-        # instantaneous occupancy (live_slots gauge, not the lifetime-mean
-        # slot_occupancy): scale-down must see idleness now, not averaged
+        # per-round occupancy from the live_slots gauge, not the lifetime-mean
+        # slot_occupancy: scale-down must see idleness now, not averaged
         # over the busy history
-        mean_occupancy = live / max(1, slots)
+        self.series.append(PRESSURE_KEY, pending / max(1, slots))
+        self.series.append(PENDING_KEY, pending)
+        self.series.append(OCCUPANCY_KEY, live / max(1, slots))
+        # conservative windowed reads: every point in the window must show
+        # pressure before a round counts toward scale-up (min), and every
+        # point must show idleness before one counts toward scale-down (max).
+        # window_rounds=1 → these are exactly the instantaneous values.
+        w = self.window_rounds
+        pressure = self.series.reduce(PRESSURE_KEY, "min", w)
+        pending = self.series.reduce(PENDING_KEY, "max", w)
+        mean_occupancy = self.series.reduce(OCCUPANCY_KEY, "max", w)
         if self._cooldown > 0:
             self._cooldown -= 1
             # streaks reset during cooldown: the refractory window demands

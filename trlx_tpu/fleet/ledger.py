@@ -19,7 +19,14 @@ the ``fleet/*`` gauge namespace (docs/observability.md):
   lifecycle churn;
 - ``fleet/shed`` / ``fleet/expired`` / ``fleet/finished`` and per-class /
   per-tenant breakdowns ``fleet/class/<c>/*``, ``fleet/tenant/<t>/*``
-  including nearest-rank p99 latency over a bounded window.
+  including nearest-rank p99 latency over a bounded window;
+- ``fleet/alert/fast_burn`` / ``fleet/alert/slow_burn`` /
+  ``fleet/alert/firing`` — SLO error-budget burn rates over a fast and a
+  slow window of terminal outcomes (multi-window burn-rate alerting: the
+  fast window catches an outage quickly, the slow window keeps a brief
+  blip from paging). Burn rate is ``windowed_bad_fraction / error_budget``
+  where the budget is ``1 - slo_target``; the alert fires only when BOTH
+  windows exceed ``burn_threshold``.
 
 Thread-safety: ``note_route`` runs on producer threads (inside the router's
 ``submit``), ``record`` on the driving thread — one lock covers all counters
@@ -28,15 +35,16 @@ and windows, held only for the bookkeeping itself.
 
 import threading
 from collections import deque
-from typing import Dict
+from typing import Dict, Optional
 
+from trlx_tpu.obs.timeseries import SeriesStore
 from trlx_tpu.serving.scheduler import (
     FINISH_EOS,
     FINISH_LENGTH,
     FINISH_STOP,
     Request,
 )
-from trlx_tpu.utils.metrics import gauges
+from trlx_tpu.utils.metrics import gauges, nearest_rank
 
 #: finish reasons that count as a successful generation (latency sample)
 _SUCCESS = (FINISH_EOS, FINISH_STOP, FINISH_LENGTH)
@@ -44,14 +52,40 @@ _SUCCESS = (FINISH_EOS, FINISH_STOP, FINISH_LENGTH)
 #: an unbounded history (matches the engine's per-tenant window size)
 _WINDOW = 512
 
+#: series key holding the per-terminal bad-outcome indicator (1.0 = SLO miss)
+SLO_BAD_KEY = "fleet/slo/bad"
+
 
 def _nearest_rank_p99(window) -> float:
     xs = sorted(window)
-    return xs[min(len(xs) - 1, int(0.99 * len(xs)))] if xs else 0.0
+    return nearest_rank(xs, 0.99) if xs else 0.0
 
 
 class FleetLedger:
-    def __init__(self):
+    def __init__(
+        self,
+        slo_target: float = 0.99,
+        fast_window: int = 32,
+        slow_window: int = 256,
+        burn_threshold: float = 2.0,
+        series: Optional[SeriesStore] = None,
+    ):
+        if not 0.0 < slo_target < 1.0:
+            raise ValueError(f"slo_target must be in (0, 1), got {slo_target}")
+        if fast_window < 1 or slow_window < fast_window:
+            raise ValueError(
+                "need 1 <= fast_window <= slow_window, got "
+                f"fast={fast_window} slow={slow_window}"
+            )
+        self.slo_target = float(slo_target)
+        self.fast_window = int(fast_window)
+        self.slow_window = int(slow_window)
+        self.burn_threshold = float(burn_threshold)
+        # series retention must cover the slow window or slow burn silently
+        # degrades into a faster one
+        self.series = (
+            series if series is not None else SeriesStore(capacity=slow_window)
+        )
         self._lock = threading.Lock()
         self._routed = 0
         self._affinity_hits = 0
@@ -114,6 +148,8 @@ class FleetLedger:
                 self._tenant_lat.setdefault(
                     req.tenant_id, deque(maxlen=_WINDOW)
                 ).append(req.latency_s)
+        # outside the ledger lock: the store has its own (lock order stays flat)
+        self.series.append(SLO_BAD_KEY, 0.0 if reason in _SUCCESS else 1.0)  # graftcheck: noqa[CC001] — SeriesStore is internally locked; appending outside the ledger lock keeps the lock order flat
 
     # --------------------------------------------------------------- reading
 
@@ -135,6 +171,25 @@ class FleetLedger:
     def p99_by_class(self) -> Dict[int, float]:
         with self._lock:
             return {c: _nearest_rank_p99(w) for c, w in self._class_lat.items()}
+
+    def burn_rates(self) -> Dict[str, float]:
+        """Fast/slow-window SLO burn rates from the terminal-outcome series.
+
+        ``burn = windowed_bad_fraction / (1 - slo_target)`` — burn 1.0 means
+        the error budget is being spent exactly at the sustainable rate;
+        ``firing`` is 1.0 only when BOTH windows exceed ``burn_threshold``
+        (the classic multi-window guard against paging on a blip)."""
+        budget = 1.0 - self.slo_target
+        fast = self.series.reduce(SLO_BAD_KEY, "mean", self.fast_window) / budget
+        slow = self.series.reduce(SLO_BAD_KEY, "mean", self.slow_window) / budget
+        firing = (
+            fast > self.burn_threshold and slow > self.burn_threshold
+        )
+        return {
+            "fast_burn": fast,
+            "slow_burn": slow,
+            "firing": 1.0 if firing else 0.0,
+        }
 
     def export_gauges(
         self, *, replicas: int, pending_depth: int, restarts: int
@@ -160,6 +215,10 @@ class FleetLedger:
             tenant_out = {t: dict(o) for t, o in self._tenant_outcomes.items()}
         for key in ("shed", "deadline", "preempted"):
             gauges.set(f"fleet/{key}", float(outcomes.get(key, 0)))
+        burn = self.burn_rates()
+        gauges.set("fleet/alert/fast_burn", burn["fast_burn"])
+        gauges.set("fleet/alert/slow_burn", burn["slow_burn"])
+        gauges.set("fleet/alert/firing", burn["firing"])
         for cls, window in class_lat.items():
             gauges.set(f"fleet/class/{cls}/p99_latency_s", _nearest_rank_p99(window))
         for tid, window in tenant_lat.items():

@@ -51,6 +51,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from trlx_tpu.fleet.ledger import FleetLedger
+from trlx_tpu.obs.flight import flight
 from trlx_tpu.resilience.chaos import chaos
 from trlx_tpu.serving.engine import ServingEngine
 from trlx_tpu.serving.scheduler import Request
@@ -309,6 +310,16 @@ class FleetRouter:
             f"fleet: replica seat {handle.seat} died ({reason}); re-routing "
             f"{len(state['replay'])} requests to seat {target.seat}"
         )
+        if flight.enabled:
+            # a replica kill is a re-route INSIDE the same flight: the uid's
+            # journal keeps accumulating across seats, so the preempt_replay
+            # phase absorbs the adoption tax instead of the flight forking
+            t_kill = target.supervisor.engine.scheduler.clock()
+            for req in state["replay"]:
+                flight.record(
+                    req.uid, "re_route", t=t_kill,
+                    seat=target.seat, reason=reason,
+                )
         target.supervisor.engine.adopt(state)
         handle.counters_adopted = True
         self._retire(handle)

@@ -14,13 +14,27 @@ facade the trainer drives from ``TRLConfig.train.observability``:
   ``jax.Device.memory_stats()`` (host-RSS fallback on CPU).
 - :mod:`trlx_tpu.obs.watchdog` — heartbeat monitor that dumps all Python
   thread stacks when the learner or producer stops making progress.
+- :mod:`trlx_tpu.obs.flight` — per-uid request-flight journal reducing
+  lifecycle events to a per-phase latency decomposition
+  (docs/observability.md "Request flights").
+- :mod:`trlx_tpu.obs.timeseries` / :mod:`trlx_tpu.obs.export` — bounded
+  gauge time-series with windowed reductions, plus atomic JSONL and
+  Prometheus text exporters.
 """
 
+from trlx_tpu.obs.export import (
+    read_jsonl_series,
+    read_prometheus,
+    write_jsonl_series,
+    write_prometheus,
+)
+from trlx_tpu.obs.flight import Flight, FlightRecorder, flight
 from trlx_tpu.obs.islands import IslandLedger
 from trlx_tpu.obs.memory import device_memory_stats, host_rss_bytes
 from trlx_tpu.obs.overlap import OverlapWindow
 from trlx_tpu.obs.runtime import Observability, batch_token_count
 from trlx_tpu.obs.spans import SpanTracer, span, tracer
+from trlx_tpu.obs.timeseries import SeriesStore
 from trlx_tpu.obs.throughput import (
     PEAK_TFLOPS_BY_DEVICE_KIND,
     ThroughputAccountant,
@@ -31,21 +45,29 @@ from trlx_tpu.obs.throughput import (
 from trlx_tpu.obs.watchdog import StallWatchdog, format_all_stacks, watchdog
 
 __all__ = [
+    "Flight",
+    "FlightRecorder",
     "IslandLedger",
     "Observability",
     "OverlapWindow",
     "PEAK_TFLOPS_BY_DEVICE_KIND",
+    "SeriesStore",
     "SpanTracer",
     "StallWatchdog",
     "ThroughputAccountant",
     "batch_token_count",
     "detect_peak_tflops",
     "device_memory_stats",
+    "flight",
     "format_all_stacks",
     "host_rss_bytes",
     "param_count",
+    "read_jsonl_series",
+    "read_prometheus",
     "span",
     "tracer",
     "transformer_flops_per_token",
     "watchdog",
+    "write_jsonl_series",
+    "write_prometheus",
 ]

@@ -25,6 +25,7 @@ import os
 import time
 from typing import Any, Dict, Optional
 
+from trlx_tpu.obs.flight import flight as global_flight
 from trlx_tpu.obs.memory import device_memory_stats
 from trlx_tpu.obs.spans import tracer as global_tracer
 from trlx_tpu.obs.throughput import (
@@ -32,6 +33,7 @@ from trlx_tpu.obs.throughput import (
     detect_peak_tflops,
     param_count,
 )
+from trlx_tpu.obs.timeseries import SeriesStore
 from trlx_tpu.obs.watchdog import StallWatchdog
 from trlx_tpu.obs.watchdog import watchdog as global_watchdog
 from trlx_tpu.utils import logging
@@ -47,8 +49,12 @@ class Observability:
         self.cfg = cfg
         self.enabled = bool(cfg.enabled)
         self.tracer = global_tracer
+        self.flight = global_flight
         self.accountant: Optional[ThroughputAccountant] = None
         self.watchdog: Optional[StallWatchdog] = None
+        self.series: Optional[SeriesStore] = None
+        self._series_path: Optional[str] = None
+        self._prom_path: Optional[str] = None
         self._step_count = 0
         self._last_step_end: Optional[float] = None
         self._closed = False
@@ -64,6 +70,25 @@ class Observability:
             annotate_device=cfg.trace_device,
             max_events=cfg.max_trace_events,
         )
+        # getattr-defensive config reads: older ObservabilityConfig instances
+        # (tests constructing the dataclass by hand) predate the flight fields
+        if getattr(cfg, "flight", True):
+            self.flight.reset()
+            self.flight.configure(
+                enabled=True,
+                ring=getattr(cfg, "flight_ring", 2048),
+                reservoir=getattr(cfg, "flight_reservoir", 256),
+            )
+        self.series = SeriesStore(
+            capacity=int(getattr(cfg, "series_capacity", 512))
+        )
+        for name, attr in (
+            ("series_path", "_series_path"), ("prom_path", "_prom_path")
+        ):
+            p = getattr(cfg, name, None)
+            if p and not os.path.isabs(p) and logging_dir:
+                p = os.path.join(logging_dir, p)
+            setattr(self, attr, p)
         if cfg.watchdog_timeout_s > 0:
             self.watchdog = StallWatchdog(
                 cfg.watchdog_timeout_s, poll_s=cfg.watchdog_poll_s
@@ -127,10 +152,18 @@ class Observability:
         interval = self.cfg.memory_interval
         if interval and self._step_count % interval == 0:
             stats.update(device_memory_stats())
+        # flight percentiles refresh before the obs/ snapshot so the
+        # per-tenant phase gauges ride the same per-step export
+        self.flight.export_gauges()
         stats.update(gauges.snapshot("obs/"))
         # resilience gauges (retry counts, inflight checkpoint writes, commit
         # latency) ride the same per-step export to every tracker backend
         stats.update(gauges.snapshot("resilience/"))
+        if self.series is not None:
+            # one sample of EVERY gauge per step — the exporters dump these
+            # rings on close, and windowed consumers (autoscaler/ledger hold
+            # their own stores) stay decoupled from this one
+            self.series.sample()
         return stats
 
     # -------------------------------------------------------------- lifecycle
@@ -143,13 +176,32 @@ class Observability:
         if self.watchdog is not None:
             global_watchdog.install(None)  # also stops it
             self.watchdog = None
+        if self.series is not None:
+            from trlx_tpu.obs.export import write_jsonl_series, write_prometheus
+
+            try:
+                if self._series_path:
+                    p = write_jsonl_series(self.series, self._series_path)
+                    logger.info(f"wrote gauge time-series to {p}")
+                if self._prom_path:
+                    p = write_prometheus(self._prom_path)
+                    logger.info(f"wrote Prometheus exposition to {p}")
+            except OSError as e:
+                logger.warning(f"could not write series exports: {e}")
         try:
+            if self.flight.enabled and self.tracer.trace_path is not None:
+                # merge per-uid flight lanes into the span trace: one request
+                # reads as one async lane next to the host spans in Perfetto
+                self.tracer.add_events(
+                    self.flight.trace_events(epoch=self.tracer.epoch)
+                )
             path = self.tracer.write_trace()
             if path:
                 logger.info(f"wrote span trace to {path} (chrome://tracing / Perfetto)")
         except OSError as e:
             logger.warning(f"could not write span trace: {e}")
         self.tracer.configure(enabled=False)
+        self.flight.configure(enabled=False)
 
 
 def batch_token_count(batch: Any) -> tuple:

@@ -146,9 +146,13 @@ class SpanTracer:
 
         Spans recorded on worker threads between two learner steps are drained
         with the later step — per-step attribution for the overlapped phases.
+        Each path also drains its call count as ``<prefix><path>_n``, so
+        per-call latency is computable from tracker stats (seconds / n).
         """
         with self._lock:
             out = {f"{prefix}{k}": v for k, v in self._step_times.items()}
+            for k, n in self._step_counts.items():
+                out[f"{prefix}{k}_n"] = float(n)
             self._step_times.clear()
             self._step_counts.clear()
         return out
@@ -181,6 +185,23 @@ class SpanTracer:
         with open(path, "w") as f:
             json.dump(doc, f)
         return path
+
+    @property
+    def epoch(self) -> float:
+        """Timestamp origin every recorded event is relative to — external
+        event producers (the flight recorder's per-uid async lanes) rebase
+        onto this so merged events share the trace's clock."""
+        with self._lock:
+            return self._epoch
+
+    def add_events(self, events: List[Dict[str, Any]]):
+        """Merge externally produced Chrome trace events (e.g. the
+        FlightRecorder's per-uid async lanes) into the event stream, under
+        the same ``max_events`` bound as native spans."""
+        with self._lock:
+            room = max(0, self.max_events - len(self._events))
+            self._events.extend(events[:room])
+            self._dropped_events += max(0, len(events) - room)
 
     def snapshot_events(self) -> List[Dict[str, Any]]:
         """Copy of the accumulated trace events (requires ``trace_path``).

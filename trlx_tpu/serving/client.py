@@ -23,6 +23,7 @@ from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from trlx_tpu.obs.flight import flight
 from trlx_tpu.ops.generation import pad_to_bucket
 from trlx_tpu.serving.engine import PREFILL_LEN_BUCKETS, ServingEngine
 from trlx_tpu.serving.policy import (
@@ -113,7 +114,7 @@ class GenerationClient:
                             f"engine drained with request uid={uid} unaccounted "
                             f"({sent} tokens streamed)",
                             tenant_id=req.tenant_id, slo_class=req.slo_class,
-                            replica_id=self._replica_of(uid),
+                            replica_id=self._replica_of(uid), uid=uid,
                         )
         for tok in req.generated[sent:]:
             yield tok
@@ -124,14 +125,14 @@ class GenerationClient:
             raise RequestShedError(
                 f"request uid={uid} was shed after {len(req.generated)} tokens",
                 tenant_id=req.tenant_id, slo_class=req.slo_class,
-                replica_id=self._replica_of(uid),
+                replica_id=self._replica_of(uid), uid=uid,
             )
         if req.finish_reason == FINISH_DEADLINE:
             raise RequestExpiredError(
                 f"request uid={uid} expired (deadline_s={req.deadline_s}) "
                 f"after {len(req.generated)} tokens",
                 tenant_id=req.tenant_id, slo_class=req.slo_class,
-                replica_id=self._replica_of(uid),
+                replica_id=self._replica_of(uid), uid=uid,
             )
 
     # -- rollout path --------------------------------------------------------
@@ -171,22 +172,28 @@ class GenerationClient:
         B = len(prompts)
         seqs = np.full((B, P + N), engine.pad_token_id, np.int32)
         mask = np.zeros((B, N), np.int32)
+        t_store = engine.scheduler.clock() if flight.enabled else 0.0
         for i, (uid, p) in enumerate(zip(uids, prompts)):
             req = done[uid]
             engine.scheduler.pop_request(uid)
+            # the consumer collecting the result closes the flight's
+            # store_wait tail (stream_batch leaves this to the trainer's
+            # dispatch, which stores per-sample after reward resolution)
+            if flight.enabled:
+                flight.record(uid, "store", t=t_store)
             if tenant_id is not None:
                 if req.finish_reason == FINISH_SHED:
                     raise RequestShedError(
                         f"batch member uid={uid} was shed",
                         tenant_id=req.tenant_id, slo_class=req.slo_class,
-                        replica_id=self._replica_of(uid),
+                        replica_id=self._replica_of(uid), uid=uid,
                     )
                 if req.finish_reason == FINISH_DEADLINE:
                     raise RequestExpiredError(
                         f"batch member uid={uid} expired "
                         f"(deadline_s={req.deadline_s})",
                         tenant_id=req.tenant_id, slo_class=req.slo_class,
-                        replica_id=self._replica_of(uid),
+                        replica_id=self._replica_of(uid), uid=uid,
                     )
             p = np.asarray(p, np.int32)
             gen = np.asarray(req.generated, np.int32)

@@ -68,6 +68,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from trlx_tpu.obs.flight import flight
 from trlx_tpu.ops.generation import left_pad_batch, pad_to_bucket
 from trlx_tpu.ops.sampling import count_accepted_drafts, sample_token
 from trlx_tpu.resilience.chaos import chaos
@@ -81,7 +82,7 @@ from trlx_tpu.serving.policy import (
 from trlx_tpu.serving.scheduler import InflightScheduler, Request
 from trlx_tpu.serving.tenancy import TenantRegistry, select_victim
 from trlx_tpu.utils import logging
-from trlx_tpu.utils.metrics import gauges
+from trlx_tpu.utils.metrics import gauges, nearest_rank
 
 logger = logging.get_logger(__name__)
 
@@ -635,6 +636,10 @@ class ServingEngine:
             self._lens[slot] = req.prefilled
             self.stats.prefill_tokens += n_v
             self.stats.chunk_appends += 1
+            if flight.enabled:
+                flight.record(
+                    req.uid, "prefill_chunk", t=self.scheduler.clock(),
+                )
             if req.prefilled >= len(ids_full):
                 # prompt complete: unmask the slot into the decode batch
                 self._prefilling[slot] = False
@@ -798,6 +803,14 @@ class ServingEngine:
         if not live:
             return finished
         chaos.fail_if_armed("serving-decode", f"{len(live)} live slots")
+        if flight.enabled:
+            # journal BEFORE the device step: the request may finish inside
+            # it, and the round marks decode participation either way
+            t_round = self.scheduler.clock()
+            for s in live:
+                flight.record(
+                    self.scheduler.slots[s].uid, "decode_round", t=t_round
+                )
         self._push_mirrors()
         new_counts = np.array(
             [len(r.generated) if r is not None else 0 for r in self.scheduler.slots],
@@ -858,6 +871,11 @@ class ServingEngine:
         for slot in live:
             a = int(acc_np[slot])
             self.stats.spec_accepted_tokens += a
+            if flight.enabled and a > 0:
+                flight.record(
+                    self.scheduler.slots[slot].uid, "spec_accept",
+                    t=self.scheduler.clock(), accepted=a,
+                )
             self._pending_tok[slot] = y_np[slot, a]
             done, emitted = self.scheduler.on_tokens(
                 slot, [int(t) for t in y_np[slot, : a + 1]]
@@ -1051,7 +1069,7 @@ class ServingEngine:
         xs = sorted(window)
         if not xs:
             return 0.0
-        return xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+        return nearest_rank(xs, 0.99)
 
     def export_gauges(self) -> None:
         s = self.summary()

@@ -34,7 +34,12 @@ class _TenantTagged:
     ``replica_id`` identifies which fleet replica raised (None outside a
     fleet): fleet-level retry logic distinguishes engine-fatal outcomes
     (re-route the request away from that replica) from request-fatal ones
-    (the request itself is shed/expired — retrying elsewhere won't help)."""
+    (the request itself is shed/expired — retrying elsewhere won't help).
+
+    ``uid`` keys the request's flight journal
+    (:data:`trlx_tpu.obs.flight.flight`, when observability is on): a
+    post-mortem reads the per-phase latency decomposition of the exact
+    request that shed/expired straight off the exception."""
 
     def __init__(
         self,
@@ -42,11 +47,13 @@ class _TenantTagged:
         tenant_id: Optional[str] = None,
         slo_class: Optional[int] = None,
         replica_id: Optional[int] = None,
+        uid: Optional[int] = None,
     ):
         super().__init__(*args)
         self.tenant_id = tenant_id
         self.slo_class = slo_class
         self.replica_id = replica_id
+        self.uid = uid
 
 
 class RequestTooLarge(_TenantTagged, ValueError):

@@ -57,7 +57,7 @@ from trlx_tpu.serving.scheduler import (
 from trlx_tpu.serving.supervisor import ServingSupervisor
 from trlx_tpu.serving.tenancy import TenantRegistry, jain_fairness
 from trlx_tpu.utils import logging
-from trlx_tpu.utils.metrics import gauges
+from trlx_tpu.utils.metrics import gauges, nearest_rank
 
 logger = logging.get_logger(__name__)
 
@@ -126,7 +126,7 @@ class ScenarioReport:
 
 def _nearest_rank_p99(xs: Sequence[float]) -> float:
     s = sorted(xs)
-    return s[min(len(s) - 1, int(0.99 * len(s)))] if s else 0.0
+    return nearest_rank(s, 0.99) if s else 0.0
 
 
 def _build_arrivals(

@@ -45,6 +45,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from trlx_tpu.obs import watchdog
+from trlx_tpu.obs.flight import flight
 from trlx_tpu.serving.engine import ServingEngine
 from trlx_tpu.serving.scheduler import Request
 from trlx_tpu.utils import logging
@@ -246,6 +247,15 @@ class ServingSupervisor:
         # fold into the replay queue (prompt + generated-so-far), pending and
         # finished-but-uncollected carry over, uids stay unique
         state = old.scheduler.export_state()
+        if flight.enabled:
+            # a supervised restart is an intra-seat re-route: the same flight
+            # keeps accumulating, and everything from here until decoding
+            # resumes on the successor is preempt_replay tax (pending
+            # requests that never held device state keep waiting in
+            # queue_wait — the recorder distinguishes them)
+            t_kill = old.scheduler.clock()
+            for req in state["replay"]:
+                flight.record(req.uid, "re_route", t=t_kill, reason=reason)
         logger.warning(
             f"restarting serving engine ({n}/{self.max_restarts}, "
             f"backoff {backoff:.2f}s, replaying {len(state['replay'])} requests) "

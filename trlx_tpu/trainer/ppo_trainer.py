@@ -28,6 +28,7 @@ from trlx_tpu.models.policy import (
 )
 from trlx_tpu.models.transformer import TransformerLM
 from trlx_tpu.obs import span
+from trlx_tpu.obs.flight import flight
 from trlx_tpu.parallel import mesh as mesh_lib
 from trlx_tpu.parallel.sharding import make_param_shardings
 from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
@@ -793,7 +794,10 @@ class PPOTrainer(MeshRLTrainer):
 
         window = OverlapWindow()
         reorder = ReorderBuffer()
-        pending = deque()  # (gidx, future, prompt, out_ids) in completion order
+        # one clock for flight events: the engine scheduler's (so the reward /
+        # store_wait tail lines up with the engine-side phase decomposition)
+        flight_clock = self._serving_client.engine.scheduler.clock
+        pending = deque()  # (gidx, future, prompt, out_ids, uid) in completion order
         ready = deque()  # reward resolved, waiting for a full microbucket
         inflight = [None]  # one dispatched-but-unharvested scoring bucket
         dropped = [False]  # quarantine broke the 1:1 index map → stop staging
@@ -892,9 +896,14 @@ class PPOTrainer(MeshRLTrainer):
             kl_per_token = np.exp(log_ratio) - 1.0 - log_ratio
             accumulated_kl.append(kl_per_token.sum(axis=1).mean())
             kl_coef = self.kl_ctl.value
+            t_store = flight_clock() if flight.enabled else 0.0
             new_elements = []
             for j in range(n_real):
-                _, prompt, out, _ = items[j]
+                _, prompt, out, _, uid = items[j]
+                if flight.enabled:
+                    # the scored element lands in the rollout store here — the
+                    # flight's store_wait tail closes
+                    flight.record(uid, "store", t=t_store)
                 l = int(rm[j].sum())
                 rewards = -kl_coef * log_ratio[j, :l]
                 if dense_scores is not None:
@@ -963,11 +972,14 @@ class PPOTrainer(MeshRLTrainer):
             # move FIFO-completed rewards to ready: bucket composition follows
             # engine completion order (deterministic), never worker timing
             while pending:
-                gidx, fut, prompt, out = pending[0]
+                gidx, fut, prompt, out, uid = pending[0]
                 if not (block or fut.done()):
                     break
                 pending.popleft()
-                ready.append((gidx, prompt, out, fut.result()[0]))
+                result = fut.result()[0]
+                if flight.enabled:
+                    flight.record(uid, "reward_done", t=flight_clock())
+                ready.append((gidx, prompt, out, result, uid))
             while len(ready) >= mb:
                 dispatch([ready.popleft() for _ in range(mb)])
 
@@ -1012,7 +1024,11 @@ class PPOTrainer(MeshRLTrainer):
                             **{k: [v[i]] for k, v in _meta.items()},
                         )
                         fut = pool.submit(stream_reward, kw)
-                        pending.append((gidx, fut, prompt, out_ids[0]))
+                        if flight.enabled:
+                            flight.record(
+                                req.uid, "reward_dispatch", t=flight_clock()
+                            )
+                        pending.append((gidx, fut, prompt, out_ids[0], req.uid))
                         if serialize:
                             fut.result()  # seeded regression: serial consumption
                         pump()

@@ -28,10 +28,23 @@ default tokenization (lowercase, runs of [a-z0-9]) and no stemming
 (evaluate's default ``use_stemmer=False``).
 """
 
+import math
 import re
 import threading
 from collections import Counter, deque
 from typing import Dict, List, Sequence
+
+
+def nearest_rank(sorted_values: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted sample: the smallest
+    member such that at least ``q`` of the sample is <= it, i.e. index
+    ``ceil(q*n) - 1``. The previous ``int(q*n)`` indexing selected one rank
+    too high for most n (n=2 p50 returned the LARGER value); every percentile
+    in the repo now routes through this one definition."""
+    n = len(sorted_values)
+    if n == 0:
+        return 0.0
+    return sorted_values[min(n - 1, max(0, math.ceil(q * n) - 1))]
 
 
 class GaugeRegistry:
@@ -77,8 +90,8 @@ class GaugeRegistry:
             count = self._hist_counts[name]
         n = len(values)
         # nearest-rank percentiles: exact window members, no interpolation
-        p50 = values[min(n - 1, int(0.50 * n))]
-        p95 = values[min(n - 1, int(0.95 * n))]
+        p50 = nearest_rank(values, 0.50)
+        p95 = nearest_rank(values, 0.95)
         return {
             "p50": p50,
             "p95": p95,
