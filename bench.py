@@ -741,6 +741,122 @@ def _fleet_perf(jax):
     }
 
 
+def _online_grpo_perf(jax):
+    """Online GRPO loop leg (docs/online.md "The closed loop"): a sampling
+    fleet serves grouped traffic, the PreferenceCollector harvests labeled
+    groups, and a GRPO learner steps on the drained experience. Headlines:
+    labels/s harvested through the fleet, learner steps/s on the harvested
+    groups, and slo_held — whether the fleet ledger burned zero SLO error
+    budget while the loop ran (serving and learning sharing a box must not
+    cost the servers their SLO)."""
+    from trlx_tpu.fleet import FleetRouter
+    from trlx_tpu.methods.grpo import GRPOConfig
+    from trlx_tpu.models.presets import PRESETS
+    from trlx_tpu.models.transformer import TransformerLM
+    from trlx_tpu.online import OnlineExperienceBuffer, PreferenceCollector
+    from trlx_tpu.serving import ServingEngine
+    from trlx_tpu.utils.modeling import logprobs_of_labels
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    on_cpu = jax.default_backend() == "cpu"
+    base = PRESETS["gpt2"].replace(
+        compute_dtype=jnp.float32 if on_cpu else jnp.bfloat16,
+        **(dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                max_position_embeddings=64) if on_cpu else {}),
+    )
+    G, P, N, n_waves, n_prompts = (2, 4, 6, 4, 2) if on_cpu else (4, 16, 16, 8, 4)
+    learn_steps = 10 if on_cpu else 30
+
+    model = TransformerLM(base)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 8), jnp.int32), jnp.ones((1, 8), jnp.int32),
+    )["params"]
+
+    def factory(seat):
+        return ServingEngine(
+            model, params, num_slots=4, max_seq_len=P + N + 2, block_size=4,
+            num_blocks=0, eos_token_id=None, pad_token_id=0,
+            gen_kwargs=dict(do_sample=True), seed=seat + 1,
+        )
+
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, base.vocab_size, size=P).tolist()
+               for _ in range(n_prompts)]
+
+    def reward_fn(prompt, completions):
+        return [float(np.mean(c)) / base.vocab_size for c in completions]
+
+    router = FleetRouter(factory, 2, wedge_timeout_s=None, backoff_base_s=0.01)
+    buf = OnlineExperienceBuffer(capacity=256, max_staleness=8)
+    col = PreferenceCollector(buf, group_size=G, reward_fn=reward_fn)
+    t0 = time.time()
+    try:
+        for _ in range(n_waves):
+            uids = [router.submit(list(p), N) for p in prompts for _ in range(G)]
+            got = 0
+            while got < len(uids):
+                router.step()
+                got += col.harvest(router, policy_version=0)
+        harvest_s = time.time() - t0
+        labels = col.stats()["labels_harvested"]
+
+        # GRPO learner over the harvested groups (fixed-length sequences:
+        # the leg measures step rate, not ragged padding)
+        groups = buf.drain(256, learner_version=0)
+        method = GRPOConfig(name="GRPOConfig", num_rollouts=G, chunk_size=G,
+                            group_size=G)
+        ids = jnp.asarray(
+            [list(g.prompt) + list(c) for g in groups for c in g.completions],
+            jnp.int32,
+        )
+        scores = np.concatenate([g.scores for g in groups])
+        adv = jnp.asarray(
+            np.repeat(method.group_normalize(scores)[:, None], N, axis=1)
+        )
+        mask = jnp.ones((ids.shape[0], N), jnp.float32)
+        zeros = jnp.zeros_like(mask)
+
+        def comp_logprobs(p):
+            logits, _, _, _ = model.apply({"params": p}, ids, jnp.ones_like(ids))
+            return logprobs_of_labels(logits[:, :-1], ids[:, 1:])[:, P - 1:]
+
+        old_lp = jax.lax.stop_gradient(comp_logprobs(params))
+
+        def loss_fn(p):
+            loss, _ = method.loss(comp_logprobs(p), zeros, old_lp, zeros,
+                                  adv, zeros, mask)
+            return loss
+
+        step = jax.jit(jax.value_and_grad(loss_fn))
+        step(params)[0].block_until_ready()  # compile outside the timing
+        t1 = time.time()
+        learned = params
+        for _ in range(learn_steps):
+            _, grads = step(learned)
+            learned = jax.tree_util.tree_map(
+                lambda w, g: w - 0.1 * g, learned, grads
+            )
+        jax.tree_util.tree_leaves(learned)[0].block_until_ready()
+        train_s = time.time() - t1
+
+        # republish + one more served wave under the updated policy
+        router.set_params(learned)
+        extra = [router.submit(list(prompts[0]), N) for _ in range(G)]
+        router.run(extra)
+        burn = router.ledger.burn_rates()
+    finally:
+        router.close()
+    return {
+        "online_labels_per_s": round(labels / max(harvest_s, 1e-9), 2),
+        "online_learner_steps_per_s": round(learn_steps / max(train_s, 1e-9), 2),
+        "online_groups_harvested": len(groups),
+        "online_slo_held": bool(burn["firing"] == 0.0),
+    }
+
+
 def _serving_flight_perf(jax):
     """Request-flight telemetry leg (docs/observability.md "Request flights"):
     the per-phase latency decomposition of the multi-tenant chaos soak, plus
@@ -1519,6 +1635,10 @@ def measure():
         result.update(legs.run("serving_overlap", lambda: _serving_overlap_perf(jax)))
     except Exception as e:
         result["serving_overlap_perf_error"] = f"{type(e).__name__}: {e}"[:300]
+    try:
+        result.update(legs.run("online_grpo", lambda: _online_grpo_perf(jax)))
+    except Exception as e:
+        result["online_grpo_perf_error"] = f"{type(e).__name__}: {e}"[:300]
     try:
         result.update(legs.run("island", lambda: _island_perf(jax)))
     except Exception as e:

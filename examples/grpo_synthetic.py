@@ -1,0 +1,129 @@
+"""GRPO on a seeded synthetic environment, CPU-runnable end to end.
+
+Two demos in one script (docs/online.md):
+
+1. **Seeded synthetic preference stream** — a `PreferenceCollector` with a
+   deterministic pairwise judge harvests hand-served completion groups into
+   an `OnlineExperienceBuffer`, printing the harvest/dedup/buffer stats the
+   fleet path exports as `online/*` gauges. This is the label plumbing the
+   serving fleet feeds in production, run standalone.
+
+2. **GRPO training via the `environment` dispatch row** —
+   `trlx_tpu.train(environment=SyntheticEnvironment(...))` trains a tiny
+   char-level model with group-relative advantages: reward is the fraction
+   of generated tokens equal to the target token ('a'), so a learning run
+   visibly drifts its samples toward 'a'-heavy strings.
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+import trlx_tpu
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_grpo_config
+from trlx_tpu.online import (
+    OnlineExperienceBuffer,
+    PreferenceCollector,
+    SyntheticEnvironment,
+)
+from trlx_tpu.serving.scheduler import FINISH_EOS, Request
+
+ALPHABET = "abcdefgh "
+GROUP_SIZE = 4
+
+
+def demo_preference_stream(seed: int = 0) -> None:
+    """Harvest a seeded stream of completion groups through the pairwise
+    judge — the standalone version of what the fleet collector does with
+    live traffic."""
+    rng = np.random.default_rng(seed)
+    buffer = OnlineExperienceBuffer(capacity=32, max_staleness=4)
+
+    def judge(prompt, a, b):
+        # deterministic synthetic preference: more target tokens wins
+        score = lambda c: sum(1 for t in c if t == 3)  # id of 'a'
+        if score(a) == score(b):
+            return 0.5
+        return 1.0 if score(a) > score(b) else 0.0
+
+    collector = PreferenceCollector(
+        buffer, group_size=GROUP_SIZE, preference_fn=judge
+    )
+    for uid in range(4 * GROUP_SIZE):
+        req = Request(
+            uid=uid,
+            prompt=[3, 4, 5],  # groups key on the prompt
+            max_new_tokens=8,
+        )
+        req.generated = rng.integers(3, 3 + len(ALPHABET), size=6).tolist()
+        req.finish_reason = FINISH_EOS
+        collector.observe(req, policy_version=0)
+        collector.observe(req, policy_version=0)  # dedup eats the replay
+    print("collector:", collector.stats())
+    print("buffer:   ", buffer.stats())
+    drained = buffer.drain(32)
+    print(f"drained {len(drained)} groups; first group win-rates:",
+          drained[0].scores.tolist())
+
+
+def build_config() -> TRLConfig:
+    config = default_grpo_config()
+    return config.evolve(
+        train={
+            "seq_length": 48,
+            "batch_size": 8,
+            "minibatch_size": 4,
+            "total_steps": 40,
+            "epochs": 10,
+            "checkpoint_interval": 1000,
+            "eval_interval": 20,
+            "checkpoint_dir": "ckpts/grpo_synthetic",
+            "tracker": "jsonl",
+            "seed": 1,
+        },
+        method={
+            "num_rollouts": 32,
+            "chunk_size": 8,
+            "group_size": GROUP_SIZE,
+            "gen_kwargs": {"max_new_tokens": 8, "top_k": 0, "top_p": 1.0,
+                           "do_sample": True},
+        },
+        model={
+            "model_path": "gpt2",
+            "model_overrides": dict(
+                vocab_size=len(ALPHABET) + 3, hidden_size=64, num_layers=2,
+                num_heads=2, intermediate_size=256,
+                max_position_embeddings=64,
+            ),
+        },
+        tokenizer={"tokenizer_path": f"char://{ALPHABET}"},
+        mesh={"data": 1, "fsdp": 1, "model": 1, "compute_dtype": "float32"},
+    )
+
+
+def main(hparams=None):
+    demo_preference_stream()
+    config = TRLConfig.update(build_config().to_dict(), hparams or {})
+    env = SyntheticEnvironment(
+        vocab_size=len(ALPHABET) + 3,
+        prompt_len=4,
+        target_token=3,  # char id of 'a'
+        max_turns=1,
+        seed=7,
+    )
+    prompts = ["ab c", "cd e", "ef g", "gh a", "a bc", "b cd", "c de", "d ef"]
+    trlx_tpu.train(
+        environment=env,
+        prompts=prompts,
+        eval_prompts=prompts[:4],
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    main(json.loads(sys.argv[1]) if len(sys.argv) > 1 else {})

@@ -297,6 +297,30 @@ if JAX_PLATFORMS=cpu TRLX_FLIGHT_SEED_REGRESSION=drop_terminal timeout -k 10 600
 fi
 echo "seeded drop_terminal correctly rejected"
 
+echo "== grpo + online loop tests (CPU)"
+# GRPO method/trainer (group-normalized advantages, constant-group no-op,
+# PPO plumbing parity) + the online label pipeline (bounded buffer,
+# staleness drain, exactly-once harvest under replica-kill chaos, the
+# e2e soak: harvest -> GRPO learner improves a scripted-reward policy with
+# zero SLO burn). No "not slow" filter: the slow-marked acceptance soak and
+# the replica-kill harvest MUST run here — tier-1 skips them for budget.
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_grpo.py tests/test_online.py -q \
+    -p no:cacheprovider
+
+echo "== online seeded-regression gate (double_harvest must break exactly-once)"
+# the online gate proves itself like the flight/spec/tenant gates: disable
+# the collector's uid dedup (TRLX_ONLINE_SEED_REGRESSION=double_harvest)
+# and require the exactly-once harvest tests to FAIL — an exactly-once
+# property a double-harvesting collector can satisfy is not being checked
+if JAX_PLATFORMS=cpu TRLX_ONLINE_SEED_REGRESSION=double_harvest timeout -k 10 600 \
+    python -m pytest tests/test_online.py -q -k "exactly_once and not seed_regression" \
+    -p no:cacheprovider > /dev/null 2>&1; then
+    echo "FATAL: seeded double_harvest regression was NOT caught by the exactly-once gate" >&2
+    exit 1
+fi
+echo "seeded double_harvest correctly rejected"
+
 echo "== chaos soak smoke (CPU)"
 # the acceptance scenario by name: producer crashes + nan-loss + bad elements
 # + reward faults in one run, every recovery visible in gauges/summary

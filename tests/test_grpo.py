@@ -1,0 +1,306 @@
+"""GRPO method/trainer tests (docs/online.md "GRPO"): group-normalization
+math (constant-reward group => exactly zero advantage => no-op update),
+GRPO-vs-PPO shared-plumbing parity (the GRPO loss IS PPO's policy component
+for identical inputs), critic-free returns-to-go advantages, config
+validation and registry round-trips, and the trainer-level group layout
+(each decode batch holds whole adjacent groups)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.methods.grpo import GRPOConfig
+from trlx_tpu.methods.ppo import PPOConfig
+
+pytestmark = pytest.mark.grpo
+
+
+def _grpo(**kw):
+    base = dict(name="GRPOConfig", num_rollouts=8, chunk_size=4, group_size=4)
+    base.update(kw)
+    return GRPOConfig(**base)
+
+
+# ------------------------------------------------------------- group math
+
+
+def test_group_normalize_centers_and_scales_per_group():
+    m = _grpo()
+    scores = np.array([0.0, 1.0, 2.0, 3.0, 10.0, 10.0, 20.0, 20.0], np.float32)
+    adv = m.group_normalize(scores)
+    grouped = adv.reshape(2, 4)
+    # each group is mean-zero and (population) unit-std
+    np.testing.assert_allclose(grouped.mean(axis=1), 0.0, atol=1e-6)
+    np.testing.assert_allclose(grouped.std(axis=1), 1.0, atol=1e-4)
+    # order preserved within groups
+    assert np.all(np.diff(grouped[0]) > 0)
+    assert adv[4] < adv[6]
+
+
+def test_constant_reward_group_has_exactly_zero_advantage():
+    """The centered residual of a constant group is identically 0 — the eps
+    guard never manufactures signal from a degenerate group."""
+    m = _grpo()
+    adv = m.group_normalize(np.full(8, 3.7, np.float32))
+    assert np.all(adv == 0.0)  # exact, not approx
+
+
+def test_group_normalize_rejects_misaligned_scores():
+    with pytest.raises(ValueError, match="multiple of group_size"):
+        _grpo().group_normalize(np.ones(6, np.float32))
+
+
+def test_zero_advantage_is_a_noop_update():
+    """Constant-reward group => zero advantages => zero loss AND zero
+    gradient through the clipped surrogate (no-op update)."""
+    m = _grpo()
+    B, T = 4, 6
+    rng = np.random.default_rng(0)
+    old_logprobs = jnp.asarray(rng.normal(size=(B, T)), jnp.float32)
+    mask = jnp.ones((B, T), jnp.float32)
+    zeros = jnp.zeros((B, T), jnp.float32)
+
+    def loss_of(logprobs):
+        loss, _ = m.loss(
+            logprobs, zeros, old_logprobs, zeros, zeros, zeros, mask
+        )
+        return loss
+
+    logprobs = jnp.asarray(rng.normal(size=(B, T)), jnp.float32)
+    loss, grads = jax.value_and_grad(loss_of)(logprobs)
+    assert float(loss) == 0.0
+    assert float(jnp.abs(grads).max()) == 0.0
+
+
+# ----------------------------------------------------- PPO plumbing parity
+
+
+def test_grpo_loss_is_ppo_policy_component():
+    """For identical inputs the GRPO loss equals the policy_loss component
+    of the PPO loss — same surrogate, same clipping, same k3 KL stat; GRPO
+    just drops the value term. This is the shared-plumbing parity that keeps
+    the two methods one codepath apart."""
+    rng = np.random.default_rng(1)
+    B, T = 8, 5
+    logprobs = jnp.asarray(rng.normal(size=(B, T)), jnp.float32)
+    old_logprobs = jnp.asarray(rng.normal(size=(B, T)), jnp.float32)
+    values = jnp.asarray(rng.normal(size=(B, T)), jnp.float32)
+    old_values = jnp.asarray(rng.normal(size=(B, T)), jnp.float32)
+    advantages = jnp.asarray(rng.normal(size=(B, T)), jnp.float32)
+    returns = jnp.asarray(rng.normal(size=(B, T)), jnp.float32)
+    mask = jnp.asarray(rng.integers(0, 2, size=(B, T)), jnp.float32)
+
+    grpo = _grpo(cliprange=0.2)
+    ppo = PPOConfig(cliprange=0.2)
+    g_loss, g_stats = grpo.loss(
+        logprobs, values, old_logprobs, old_values, advantages, returns, mask
+    )
+    p_loss, p_stats = ppo.loss(
+        logprobs, values, old_logprobs, old_values, advantages, returns, mask
+    )
+    np.testing.assert_allclose(
+        float(g_loss), float(p_stats["losses"]["policy_loss"]), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(g_stats["policy"]["approx_kl"]),
+        float(p_stats["policy"]["approx_kl"]),
+        rtol=1e-6,
+    )
+    assert float(g_stats["losses"]["value_loss"]) == 0.0
+
+
+def test_grpo_staleness_weights_match_ppo_path():
+    """The staleness IS reweighting rides through GRPO identically: weights
+    are exactly 1.0 at staleness 0 (bitwise-equal loss)."""
+    rng = np.random.default_rng(2)
+    B, T = 4, 3
+    args = [jnp.asarray(rng.normal(size=(B, T)), jnp.float32) for _ in range(6)]
+    mask = jnp.ones((B, T), jnp.float32)
+    m = _grpo()
+    base, _ = m.loss(*args, mask)
+    zero_stale, stats = m.loss(
+        *args, mask, staleness=jnp.zeros((B,), jnp.int32), is_ratio_clip=2.0
+    )
+    assert float(base) == float(zero_stale)
+    assert float(stats["staleness"]["is_weight_mean"]) == 1.0
+
+
+# ------------------------------------------------- critic-free advantages
+
+
+def test_advantages_are_discounted_returns_to_go():
+    """With no critic, GRPO advantages are the discounted returns-to-go of
+    the per-token rewards (GAE with zero values and lam=1) — checked against
+    a direct reverse cumulative sum."""
+    rng = np.random.default_rng(3)
+    B, T, gamma = 3, 5, 0.9
+    rewards = rng.normal(size=(B, T)).astype(np.float32)
+    mask = np.ones((B, T), np.float32)
+    mask[1, 3:] = 0.0  # one short response
+    m = _grpo(gamma=gamma)
+    adv, returns = m.get_advantages_and_returns(
+        jnp.zeros((B, T), jnp.float32), jnp.asarray(rewards), jnp.asarray(mask)
+    )
+    expected = np.zeros((B, T), np.float32)
+    masked = rewards * mask
+    for t in reversed(range(T)):
+        nxt = expected[:, t + 1] * mask[:, t + 1] if t + 1 < T else 0.0
+        expected[:, t] = masked[:, t] + gamma * nxt
+    np.testing.assert_allclose(np.asarray(adv), expected * mask, rtol=1e-5)
+    # the zero "returns" keep the inherited value plumbing inert
+    assert float(jnp.abs(returns).max()) == 0.0
+
+
+def test_whiten_advantages_opt_in():
+    rng = np.random.default_rng(4)
+    rewards = jnp.asarray(rng.normal(size=(4, 6)), jnp.float32)
+    mask = jnp.ones((4, 6), jnp.float32)
+    zeros = jnp.zeros((4, 6), jnp.float32)
+    plain, _ = _grpo().get_advantages_and_returns(zeros, rewards, mask)
+    white, _ = _grpo(whiten_advantages=True).get_advantages_and_returns(
+        zeros, rewards, mask
+    )
+    assert not np.allclose(np.asarray(plain), np.asarray(white))
+    assert abs(float(white.mean())) < 1e-5  # whitened to mean zero
+
+
+# ------------------------------------------------------ config / registry
+
+
+def test_grpo_config_validation():
+    with pytest.raises(ValueError, match="group_size"):
+        _grpo(group_size=1)
+    with pytest.raises(ValueError, match="num_rollouts"):
+        _grpo(num_rollouts=6, chunk_size=4, group_size=4)
+    with pytest.raises(ValueError, match="chunk_size"):
+        _grpo(num_rollouts=8, chunk_size=6, group_size=4)
+
+
+def test_grpo_registry_and_config_roundtrip():
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.data.default_configs import default_grpo_config
+    from trlx_tpu.data.method_configs import get_method
+    from trlx_tpu.utils.loading import get_trainer
+
+    assert get_method("GRPOConfig") is GRPOConfig
+    config = default_grpo_config()
+    assert isinstance(config.method, GRPOConfig)
+    assert config.train.trainer == "GRPOTrainer"
+    assert config.method.gen_kwargs["do_sample"] is True
+    restored = TRLConfig.from_dict(config.to_dict())
+    assert isinstance(restored.method, GRPOConfig)
+    assert restored.method.group_size == config.method.group_size
+    assert get_trainer("GRPOTrainer").__name__ == "GRPOTrainer"
+
+
+def test_train_dispatch_error_mentions_environment(monkeypatch):
+    import trlx_tpu.trlx as trlx_mod
+    from trlx_tpu.data.default_configs import default_grpo_config
+
+    # stub the trainer factory: only the dispatch branch is under test
+    monkeypatch.setattr(
+        trlx_mod, "get_trainer", lambda name: lambda **kw: object()
+    )
+    with pytest.raises(ValueError, match="environment"):
+        trlx_mod.train(config=default_grpo_config())
+
+
+def test_train_rejects_reward_fn_plus_environment():
+    import trlx_tpu.trlx as trlx_mod
+    from trlx_tpu.online import SyntheticEnvironment
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        trlx_mod.train(
+            reward_fn=lambda **kw: [0.0],
+            environment=SyntheticEnvironment(),
+        )
+
+
+# ------------------------------------------------------ trainer-level rig
+
+
+def _tiny_grpo_config(tmp_path, **method_kw):
+    from trlx_tpu.data.configs import (
+        MeshConfig, ModelConfig, OptimizerConfig, SchedulerConfig,
+        TokenizerConfig, TrainConfig, TRLConfig,
+    )
+
+    alphabet = "abcdefgh "
+    mkw = dict(
+        name="GRPOConfig", num_rollouts=4, chunk_size=2, group_size=2,
+        ppo_epochs=1, init_kl_coef=0.01, target=None,
+        gen_kwargs=dict(max_new_tokens=4, do_sample=True, temperature=2.0),
+    )
+    mkw.update(method_kw)
+    return TRLConfig(
+        method=GRPOConfig(**mkw),
+        train=TrainConfig(
+            seq_length=32, epochs=1, total_steps=1, batch_size=4,
+            minibatch_size=2, checkpoint_interval=100, eval_interval=100,
+            checkpoint_dir=str(tmp_path / "ckpts"), pipeline="PromptPipeline",
+            trainer="GRPOTrainer", tracker=None, seed=2,
+        ),
+        model=ModelConfig(
+            model_path="gpt2", num_layers_unfrozen=-1,
+            model_overrides=dict(
+                vocab_size=len(alphabet) + 3, hidden_size=32, num_layers=2,
+                num_heads=2, intermediate_size=64, max_position_embeddings=64,
+            ),
+        ),
+        tokenizer=TokenizerConfig(tokenizer_path=f"char://{alphabet}"),
+        optimizer=OptimizerConfig(name="adamw", kwargs=dict(lr=1e-3)),
+        scheduler=SchedulerConfig(
+            name="cosine_annealing", kwargs=dict(T_max=100, eta_min=1e-3)
+        ),
+        mesh=MeshConfig(data=1, fsdp=1, model=1, compute_dtype="float32"),
+    )
+
+
+@pytest.fixture
+def single_device_mesh(monkeypatch):
+    from trlx_tpu.parallel import mesh as mesh_lib
+
+    real = mesh_lib.make_mesh
+    monkeypatch.setattr(
+        mesh_lib, "mesh_from_config",
+        lambda cfg, devices=None: real(
+            data=1, fsdp=1, model=1, devices=jax.devices()[:1]
+        ),
+    )
+
+
+@pytest.mark.slow
+def test_grpo_trainer_generates_whole_adjacent_groups(tmp_path, single_device_mesh):
+    """The regrouped prompt stream keeps batch shapes but repeats each
+    prompt group_size times adjacently, so every stored group shares its
+    query tensor — and a full GRPO experience phase + train step runs."""
+    from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
+    from trlx_tpu.utils.loading import get_trainer
+
+    config = _tiny_grpo_config(tmp_path)
+
+    def reward_fn(samples, **kw):
+        return [float(s.count("a")) for s in samples]
+
+    trainer = get_trainer("GRPOTrainer")(config=config, reward_fn=reward_fn)
+    trainer.add_prompt_pipeline(
+        PromptPipeline(["ab", "cd ef", "gh", "a b c"], 12, trainer.tokenizer)
+    )
+    trainer.make_experience(4, 0)
+    history = trainer.store.history
+    assert len(history) == 4
+    g = config.method.group_size
+    for start in range(0, len(history), g):
+        queries = [
+            np.asarray(history[start + j].query_tensor).tolist() for j in range(g)
+        ]
+        assert all(q == queries[0] for q in queries[1:])
+    # one train step over the stored experience completes and reports the
+    # GRPO stats family
+    trainer.prepare_learning()
+    batch = next(iter(trainer.create_train_dataloader()))
+    out = trainer.train_step(batch)
+    assert "group/policy_delta" in out
+    assert float(out["losses/value_loss"]) == 0.0

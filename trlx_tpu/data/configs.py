@@ -777,6 +777,58 @@ class ServingFleetConfig:
 
 
 @dataclass
+class OnlineConfig:
+    """Online learning loop: harvest labeled experience from live serving
+    traffic into the GRPO learner (``trlx_tpu/online/``; docs/online.md).
+
+    With ``enabled`` off (the default) the trainer is bit-for-bit the
+    self-generating path: no buffer is built, no collector attaches, the
+    experience phase never consults harvested groups.
+
+    :param enabled: master switch for the online experience path.
+    :param group_size: completions per harvested group; must equal the GRPO
+        method's ``group_size`` (the trainer enforces it).
+    :param buffer_capacity: bounded group count in the
+        :class:`~trlx_tpu.online.buffer.OnlineExperienceBuffer`; past it the
+        oldest group is evicted (old experience is the cheapest to lose).
+    :param max_staleness: drop harvested groups more than this many policy
+        publishes behind the learner at drain time (the same admission cap
+        async PPO uses).
+    :param label_type: how harvested groups are scored — ``"reward"``
+        (scalar reward_fn), ``"preference"`` (pairwise judge reduced to win
+        rates), or ``"environment"`` (episode returns from interaction
+        loops).
+    """
+
+    enabled: bool = False
+    group_size: int = 4
+    buffer_capacity: int = 256
+    max_staleness: int = 4
+    label_type: str = "reward"
+
+    def __post_init__(self):
+        if self.group_size < 2:
+            raise ValueError(f"group_size must be >= 2, got {self.group_size}")
+        if self.buffer_capacity < 1:
+            raise ValueError(
+                f"buffer_capacity must be >= 1, got {self.buffer_capacity}"
+            )
+        if self.max_staleness < 0:
+            raise ValueError(
+                f"max_staleness must be >= 0, got {self.max_staleness}"
+            )
+        if self.label_type not in ("reward", "preference", "environment"):
+            raise ValueError(
+                f"label_type must be 'reward' | 'preference' | 'environment', "
+                f"got {self.label_type!r}"
+            )
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        return cls(**config)
+
+
+@dataclass
 class LearnerOverlapConfig:
     """Overlapped-collective FSDP train step (``trlx_tpu/parallel/fsdp.py``;
     docs/parallelism.md "Learner overlap & FSDP").
@@ -923,6 +975,11 @@ class TrainConfig:
         default_factory=lambda: LearnerOverlapConfig()
     )
 
+    # Online learning loop (GRPO experience harvested from live serving
+    # traffic / bounded labeled-group buffer / staleness admission) — see
+    # OnlineConfig and docs/online.md.
+    online: "OnlineConfig" = field(default_factory=lambda: OnlineConfig())
+
     # score with reward_fn on process 0 only and broadcast the results to every
     # host. None (default) = auto: ON exactly when jax.process_count() > 1 —
     # otherwise every host hits a served reward model with identical requests
@@ -982,6 +1039,9 @@ class TrainConfig:
         lov = config.get("learner_overlap")
         if isinstance(lov, dict):
             config["learner_overlap"] = LearnerOverlapConfig.from_dict(lov)
+        onl = config.get("online")
+        if isinstance(onl, dict):
+            config["online"] = OnlineConfig.from_dict(onl)
         return cls(**config)
 
 

@@ -50,7 +50,7 @@ import threading
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
-from trlx_tpu.fleet.ledger import FleetLedger
+from trlx_tpu.fleet.ledger import _SUCCESS, FleetLedger
 from trlx_tpu.obs.flight import flight
 from trlx_tpu.resilience.chaos import chaos
 from trlx_tpu.serving.engine import ServingEngine
@@ -183,6 +183,7 @@ class FleetRouter:
         backoff_max_s: float = 10.0,
         wedge_timeout_s: Optional[float] = 60.0,
         diagnostics_dir: str = "diagnostics",
+        learn_tenants: Optional[Sequence[str]] = None,
     ):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
@@ -194,6 +195,12 @@ class FleetRouter:
             )
         self._seed_regression = seed_reg
         self._factory = engine_factory
+        # learn-eligibility tagging (docs/online.md): every successfully
+        # finished request is stamped learn-eligible at sweep time; a
+        # learn_tenants allow-list narrows harvesting to opted-in tenants
+        self._learn_tenants = (
+            None if learn_tenants is None else frozenset(map(str, learn_tenants))
+        )
         self.prefix_weight = float(prefix_weight)
         self.tenant_weight = float(tenant_weight)
         self.load_weight = float(load_weight)
@@ -446,6 +453,17 @@ class FleetRouter:
                 self._finished[uid] = req
                 fresh.append(req)
         for req in fresh:
+            # stamp learn-eligibility for the online collector (exactly once
+            # per uid — this loop is already dedup-guarded above): successful
+            # finishes from opted-in tenants may become GRPO training data
+            req.learn_eligible = bool(
+                req.finish_reason in _SUCCESS
+                and req.generated
+                and (
+                    self._learn_tenants is None
+                    or req.tenant_id in self._learn_tenants
+                )
+            )
             self.ledger.record(req)
 
     def _pop_finished(self) -> Dict[int, Request]:

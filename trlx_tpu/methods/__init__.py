@@ -5,12 +5,14 @@ config carries the loss (`/root/reference/trlx/data/method_configs.py`,
 built-in methods."""
 
 from trlx_tpu.methods.ppo import AdaptiveKLController, FixedKLController, PPOConfig
+from trlx_tpu.methods.grpo import GRPOConfig
 from trlx_tpu.methods.ilql import ILQLConfig
 from trlx_tpu.methods.sft import SFTConfig
 from trlx_tpu.methods.rft import RFTConfig
 
 __all__ = [
     "PPOConfig",
+    "GRPOConfig",
     "ILQLConfig",
     "SFTConfig",
     "RFTConfig",

@@ -1,0 +1,213 @@
+"""GRPO method: critic-free group-relative policy optimization.
+
+GRPO (Group Relative Policy Optimization, Shao et al. 2024, DeepSeekMath)
+replaces PPO's learned value baseline with a *group* baseline: for each
+prompt, sample a group of G completions, score them, and normalize each
+score against the group's own mean and standard deviation:
+
+    A_i = (r_i - mean(r_group)) / (std(r_group) + eps)
+
+No value head, no GAE bootstrap, no value loss — the surrogate is PPO's
+clipped policy term driven by the group-relative advantage spread over
+response tokens as discounted returns-to-go, plus the same per-token
+KL-to-reference shaping the PPO path already assembles in
+``_score_and_store``. Everything else — microbatching, the FSDP /
+overlapped-collective step, stream-overlap rollout, staleness
+importance-weighting — is inherited from :class:`PPOConfig` /
+``PPOTrainer`` unchanged, which is the point: the fleet's served
+completion groups (docs/online.md) are exactly GRPO's input shape.
+
+Two exact properties the tests pin:
+
+- a constant-reward group normalizes to *exactly* zero advantage (the
+  centered residual is identically 0 before the std division), so a
+  degenerate group is a no-op update, not a NaN;
+- for identical inputs, ``GRPOConfig.loss`` equals the ``policy_loss``
+  component of ``PPOConfig.loss`` — the shared-plumbing parity that keeps
+  the two methods one codepath apart, not two implementations.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.data.method_configs import register_method
+from trlx_tpu.methods.ppo import PPOConfig, gae_advantages_and_returns
+from trlx_tpu.utils.modeling import masked_mean
+
+#: guard for the group-std division — centered residuals of a constant
+#: group are exactly zero, so eps only sets the scale of near-ties
+GROUP_EPS = 1e-6
+
+
+@register_method
+@dataclass
+class GRPOConfig(PPOConfig):
+    """GRPO hyperparameters: :class:`PPOConfig` minus the critic.
+
+    :param group_size: completions sampled per prompt; scores normalize
+        within each group. ``num_rollouts`` and ``chunk_size`` must both be
+        multiples of ``group_size`` so groups never straddle a scoring
+        chunk (the group baseline needs the whole group in one batch).
+    :param whiten_advantages: re-whiten the per-token advantages over the
+        global batch after the group normalization. Off by default — the
+        group baseline *is* the normalization; batch whitening on top
+        changes the estimator.
+
+    Inherited value-function fields (``vf_coef``, ``cliprange_value``,
+    ``num_value_layers_unfrozen``) are inert: the loss has no value term
+    and the trainer trains no value branch.
+    """
+
+    name: str = "GRPOConfig"
+    group_size: int = 4
+    whiten_advantages: bool = False
+    # groups need diverse completions — greedy decode makes every group
+    # member identical and every advantage zero
+    gen_kwargs: Dict[str, Any] = field(
+        default_factory=lambda: dict(max_new_tokens=16, do_sample=True)
+    )
+
+    def __post_init__(self):
+        if self.group_size < 2:
+            raise ValueError(
+                f"group_size must be >= 2 (a singleton group has zero "
+                f"advantage by construction), got {self.group_size}"
+            )
+        if self.num_rollouts % self.group_size != 0:
+            raise ValueError(
+                f"num_rollouts ({self.num_rollouts}) must be a multiple of "
+                f"group_size ({self.group_size})"
+            )
+        if self.chunk_size % self.group_size != 0:
+            raise ValueError(
+                f"chunk_size ({self.chunk_size}) must be a multiple of "
+                f"group_size ({self.group_size}) — groups must not straddle "
+                f"scoring chunks"
+            )
+
+    # ------------------------------------------------------------ group math
+
+    def group_normalize(self, scores: np.ndarray) -> np.ndarray:
+        """Host-side group-relative normalization of a flat score vector.
+
+        ``scores`` is [B] with B a multiple of ``group_size`` and group
+        members adjacent (the trainer's prompt repetition guarantees the
+        layout). Returns [B] advantages. A constant group yields exact
+        zeros: the centered residual is identically 0, so the eps-guarded
+        std division never manufactures signal from a degenerate group.
+        """
+        scores = np.asarray(scores, dtype=np.float32)
+        if scores.ndim != 1 or scores.size % self.group_size != 0:
+            raise ValueError(
+                f"scores must be flat with size a multiple of group_size="
+                f"{self.group_size}, got shape {scores.shape}"
+            )
+        grouped = scores.reshape(-1, self.group_size)
+        centered = grouped - grouped.mean(axis=1, keepdims=True)
+        std = np.sqrt((centered**2).mean(axis=1, keepdims=True))
+        return (centered / (std + GROUP_EPS)).reshape(-1)
+
+    def get_advantages_and_returns(
+        self, values, rewards, mask, use_whitening: bool = True
+    ):
+        """Critic-free advantages: discounted returns-to-go of the per-token
+        rewards (group-normalized score at the last token + KL shaping),
+        computed as GAE with a zero value baseline and ``lam=1`` — the exact
+        degenerate case of the shared reverse-scan kernel. Returns zero
+        "returns" (stop-gradded) so the inherited value-loss plumbing sees a
+        fixed zero target it contributes nothing against (``vf_coef`` is
+        unused in :meth:`loss` anyway)."""
+        zeros = jnp.zeros_like(rewards)
+        advantages, _ = gae_advantages_and_returns(
+            zeros, rewards, mask, self.gamma, 1.0,
+            use_whitening=use_whitening and self.whiten_advantages,
+        )
+        return advantages, jax.lax.stop_gradient(zeros)
+
+    # ------------------------------------------------------------------ loss
+
+    def loss(
+        self,
+        logprobs: jnp.ndarray,
+        values: jnp.ndarray,
+        old_logprobs: jnp.ndarray,
+        old_values: jnp.ndarray,
+        advantages: jnp.ndarray,
+        returns: jnp.ndarray,
+        mask: jnp.ndarray,
+        staleness: Optional[jnp.ndarray] = None,
+        is_ratio_clip: Optional[float] = None,
+    ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        """PPO's clipped policy surrogate with NO value term.
+
+        Same signature as :meth:`PPOConfig.loss` so the trainer's loss_fn is
+        method-agnostic; ``values``/``old_values``/``returns`` are accepted
+        and ignored. Stats mirror PPO's key layout (``losses/value_loss`` is
+        a constant 0) plus a ``group`` block: the advantage spread actually
+        driving the update and ``policy_delta`` = mean |ratio - 1|, the
+        per-step policy movement the online loop exports as
+        ``online/policy_delta``."""
+        mask = mask.astype(logprobs.dtype)
+        # f32-pinned reductions throughout: operands may be bf16 on TPU and
+        # sequence-length sums lose exactly the small clipped terms (JX007)
+        n = jnp.maximum(mask.sum(dtype=jnp.float32), 1.0)
+
+        log_ratio = (logprobs - old_logprobs) * mask
+        ratio = jnp.exp(log_ratio)
+        # k3 estimator of approximate KL: mean(exp(-lr) - 1 + lr)
+        approx_kl = jnp.sum(
+            (jnp.exp(-log_ratio) - 1.0 + log_ratio) * mask, dtype=jnp.float32
+        ) / n
+
+        is_weights = None
+        if staleness is not None and is_ratio_clip is not None:
+            from trlx_tpu.rollout.staleness import staleness_importance_weights
+
+            is_weights = staleness_importance_weights(
+                log_ratio, staleness, is_ratio_clip
+            )
+            advantages = advantages * is_weights
+
+        pg_loss1 = -advantages * ratio
+        pg_loss2 = -advantages * jnp.clip(
+            ratio, 1.0 - self.cliprange, 1.0 + self.cliprange
+        )
+        pg_loss = jnp.sum(
+            jnp.maximum(pg_loss1, pg_loss2) * mask, dtype=jnp.float32
+        ) / n
+        pg_clipfrac = jnp.sum(
+            (pg_loss2 > pg_loss1).astype(mask.dtype) * mask, dtype=jnp.float32
+        ) / n
+
+        loss = pg_loss
+
+        adv_mean = masked_mean(advantages, mask)
+        adv_std = jnp.sqrt(masked_mean((advantages - adv_mean) ** 2, mask))
+        policy_delta = jnp.sum(
+            jnp.abs(ratio - 1.0) * mask, dtype=jnp.float32
+        ) / n
+
+        stats = dict(
+            losses=dict(
+                total_loss=loss,
+                policy_loss=pg_loss,
+                value_loss=jnp.zeros((), dtype=jnp.float32),
+            ),
+            policy=dict(approx_kl=approx_kl, clipfrac=pg_clipfrac),
+            group=dict(
+                adv_mean=adv_mean, adv_std=adv_std, policy_delta=policy_delta
+            ),
+            ratio=jnp.sum(ratio * mask, dtype=jnp.float32) / n,
+            padding_percentage=1.0 - n / mask.size,
+        )
+        if is_weights is not None:
+            stats["staleness"] = dict(
+                mean=jnp.mean(staleness.astype(jnp.float32)),
+                max=jnp.max(staleness),
+                is_weight_mean=jnp.sum(is_weights * mask, dtype=jnp.float32) / n,
+            )
+        return loss, stats

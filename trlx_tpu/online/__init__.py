@@ -1,0 +1,37 @@
+"""Online learning subsystem: harvest labeled experience from live traffic.
+
+The pieces of the closed loop (docs/online.md):
+
+- :class:`~trlx_tpu.online.buffer.OnlineExperienceBuffer` /
+  :class:`~trlx_tpu.online.buffer.LabeledGroup` — bounded, version-tagged
+  storage of scored completion groups;
+- :class:`~trlx_tpu.online.collector.PreferenceCollector` — exactly-once
+  harvest of completion groups from fleet/serving terminal requests, scored
+  by reward_fn, pairwise preference judging, or environment returns;
+- :class:`~trlx_tpu.online.environment.Environment` — the multi-turn
+  observe → generate → act → reward interface, with
+  :class:`~trlx_tpu.online.environment.SyntheticEnvironment` as the seeded
+  test world.
+
+The consumer is ``GRPOTrainer`` (``trainer/grpo_trainer.py``): fleet-served
+groups are exactly the group-relative advantage's input shape.
+"""
+
+from trlx_tpu.online.buffer import LabeledGroup, OnlineExperienceBuffer
+from trlx_tpu.online.collector import PreferenceCollector
+from trlx_tpu.online.environment import (
+    Environment,
+    SyntheticEnvironment,
+    environment_reward_fn,
+    run_environment_rollout,
+)
+
+__all__ = [
+    "Environment",
+    "LabeledGroup",
+    "OnlineExperienceBuffer",
+    "PreferenceCollector",
+    "SyntheticEnvironment",
+    "environment_reward_fn",
+    "run_environment_rollout",
+]

@@ -49,6 +49,7 @@ class BaseRLTrainer:
 
 from trlx_tpu.trainer.mesh_trainer import MeshRLTrainer  # noqa: E402,F401
 from trlx_tpu.trainer.ppo_trainer import PPOTrainer  # noqa: E402,F401
+from trlx_tpu.trainer.grpo_trainer import GRPOTrainer  # noqa: E402,F401
 from trlx_tpu.trainer.ilql_trainer import ILQLTrainer  # noqa: E402,F401
 from trlx_tpu.trainer.sft_trainer import SFTTrainer  # noqa: E402,F401
 from trlx_tpu.trainer.rft_trainer import RFTTrainer  # noqa: E402,F401

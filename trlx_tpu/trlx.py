@@ -1,12 +1,32 @@
 """Public ``train()`` entry (parity: `/root/reference/trlx/trlx.py:15-143`): one
-function dispatching online RL (reward_fn → PPO/RFT), offline RL (samples+rewards →
-ILQL) and supervised fine-tuning (samples → SFT), building the trainer, pipelines and
-running ``learn()``."""
+function dispatching every training mode, building the trainer and pipelines and
+running ``learn()``.
+
+Dispatch table (first matching row wins; ``config`` overrides the inferred
+default when given explicitly):
+
+==========================  =========================  ======================
+ given                       mode                       default config
+==========================  =========================  ======================
+ ``reward_fn``               online RL (PPO/GRPO/RFT)   ``default_ppo_config``
+ ``environment``             environment RL (GRPO)      ``default_grpo_config``
+ ``samples`` + ``rewards``   offline RL (ILQL)          ``default_ilql_config``
+ ``samples``                 supervised (SFT)           ``default_sft_config``
+==========================  =========================  ======================
+
+``environment`` is an :class:`~trlx_tpu.online.environment.Environment`
+whose reward is an interaction loop (observe → generate → act → reward); a
+stateless-scorable environment is adapted into a reward_fn here and flows
+through the prompt-pipeline path. Fleet-harvested online training
+(``train.online``; docs/online.md) also enters through the reward_fn row —
+the collector feeds the trainer's experience buffer while ``learn()`` runs.
+"""
 
 from typing import Callable, Dict, Iterable, List, Optional, Tuple, Union
 
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.default_configs import (
+    default_grpo_config,
     default_ilql_config,
     default_ppo_config,
     default_sft_config,
@@ -28,20 +48,36 @@ def train(
     metric_fn: Optional[Callable] = None,
     config: Optional[TRLConfig] = None,
     stop_sequences: Optional[List[str]] = None,
+    environment=None,
 ):
-    """Dispatch & fit. See the reference docstring for argument semantics; the
-    surface is identical (model_path, reward_fn, samples, rewards, prompts,
-    eval_prompts, metric_fn, config, stop_sequences)."""
+    """Dispatch & fit (see the module docstring's dispatch table). The
+    reference surface is identical (model_path, reward_fn, samples, rewards,
+    prompts, eval_prompts, metric_fn, config, stop_sequences) plus
+    ``environment``: an :class:`~trlx_tpu.online.environment.Environment`
+    scored through its stateless ``evaluate`` and trained with GRPO by
+    default."""
+    if reward_fn is not None and environment is not None:
+        raise ValueError(
+            "`reward_fn` and `environment` are mutually exclusive: an "
+            "environment IS the reward source"
+        )
     if config is None:
         logger.warning(
             "Passing the `config` argument implicitly is depreciated, use or adapt one of the default configs instead"
         )
         if reward_fn:
             config = default_ppo_config()
+        elif environment is not None:
+            config = default_grpo_config()
         elif rewards:
             config = default_ilql_config()
         else:
             config = default_sft_config()
+    if environment is not None:
+        # adapt the environment into the reward_fn row of the dispatch table
+        from trlx_tpu.online.environment import environment_reward_fn
+
+        reward_fn = environment_reward_fn(environment)
     if model_path:
         config.model.model_path = model_path
 
@@ -68,7 +104,8 @@ def train(
     batch_size = config.train.batch_size
     max_prompt_length = config.train.seq_length - config.method.gen_kwargs.get("max_new_tokens", 0)
 
-    # online RL (PPO / RFT): prompts + reward_fn
+    # online RL (PPO / GRPO / RFT): prompts + reward_fn (an environment was
+    # adapted into reward_fn above)
     if reward_fn:
         prompts = prompts or [trainer.tokenizer.bos_token] * batch_size
         if eval_prompts is None:
@@ -93,7 +130,11 @@ def train(
         trainer.make_experience(samples, config.train.seq_length)
 
     else:
-        raise ValueError("Either `samples` or `reward_fn` should be given for training")
+        raise ValueError(
+            "One of `samples` (SFT / +`rewards` for ILQL), `reward_fn` "
+            "(PPO/GRPO/RFT) or `environment` (GRPO over interaction "
+            "rollouts) should be given for training"
+        )
 
     eval_pipeline = get_pipeline(config.train.pipeline)(
         eval_prompts, max_prompt_length, trainer.tokenizer
