@@ -163,18 +163,37 @@ def _time_ppo_train_step(jax, module, params, tx, B, P, R, steps, seed=0,
         updates, s2 = tx.update(grads, s, p)
         return optax.apply_updates(p, updates), s2
 
-    params, opt_state = train_step(params, opt_state)
-    jax.block_until_ready(params)  # compile
-    t0 = time.time()
-    for _ in range(steps):
-        params, opt_state = train_step(params, opt_state)
-    jax.block_until_ready(params)
-    dt = (time.time() - t0) / steps
-    phases = {}
+    # compile-ledger instrumentation (graftcheck-rt): the warmup call may
+    # compile, the measured loop must not — the same zero-recompile promise
+    # the committed graftcheck-rt-budget.json pins for the real entrypoints
+    from trlx_tpu.analysis.rt.watcher import CompileWatcher
+
+    prefix = breakdown_prefix or "ppo_train"
+    entry = f"{prefix}_step"
+    watcher = CompileWatcher().install()
+    try:
+        watcher.track(entry, train_step)
+        with watcher.attributed(entry):
+            params, opt_state = train_step(params, opt_state)
+            jax.block_until_ready(params)  # compile
+        watcher.mark_steady()
+        t0 = time.time()
+        for _ in range(steps):
+            with watcher.attributed(entry):
+                params, opt_state = train_step(params, opt_state)
+        jax.block_until_ready(params)
+        dt = (time.time() - t0) / steps
+    finally:
+        watcher.uninstall()
+    led = watcher.ledger()[entry]
+    phases = {
+        f"{prefix}_compile_count_steady": int(led["steady_compiles"]),
+        f"{prefix}_compile_time_warmup_s": round(led["compile_time_warmup_s"], 4),
+    }
     if breakdown_prefix is not None:
-        phases = _ppo_phase_breakdown(
+        phases.update(_ppo_phase_breakdown(
             jax, loss_fn, tx, params, opt_state, steps, dt, breakdown_prefix
-        )
+        ))
     return dt, params, opt_state, phases
 
 
@@ -394,12 +413,24 @@ def _serving_perf(jax):
     )["params"]
     param_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
 
-    def run_once(quant, run_budgets=None, **spec):
+    from trlx_tpu.analysis.rt.watcher import CompileWatcher
+
+    def run_once(quant, run_budgets=None, watcher=None, **spec):
         trunk = TransformerLM(base.replace(kv_cache_quant=quant))
         engine = ServingEngine(
             trunk, params, num_slots=S, max_seq_len=P + N,
             gen_kwargs=dict(do_sample=False), seed=0, **spec,
         )
+        if watcher is not None:
+            # fresh engine, fresh jit caches: its compiles are a new warmup
+            watcher.mark_warmup()
+            watcher.track("serving_decode_step", engine._decode_step)
+            watcher.track("serving_prefill", engine._prefill)
+            watcher.track("serving_pack_step", engine._pack)
+            if spec.get("spec_k"):
+                watcher.track("serving_verify_step", engine._verify_step)
+            if spec.get("prefill_chunk"):
+                watcher.track("serving_chunk_step", engine._chunk_step)
 
         def one_pass():
             uids = [engine.submit(p, n) for p, n in zip(prompts, run_budgets or budgets)]
@@ -410,22 +441,40 @@ def _serving_perf(jax):
             return delivered
 
         one_pass()  # warmup: compiles every prefill bucket + the decode step
+        if watcher is not None:
+            watcher.mark_steady()
         t0 = time.time()
         delivered = one_pass()
         return delivered / (time.time() - t0), engine
 
-    tok_s, engine = run_once(quant=False)
-    out["serving_new_tok_s"] = round(tok_s, 1)
-    tok_s_q, engine_q = run_once(quant=True)
-    out["serving_new_tok_s_int8kv"] = round(tok_s_q, 1)
-    # the spec leg runs every request at the full decode budget: a 2-token
-    # budget caps that slot's lifetime multiplier by construction, and the
-    # leg exists to measure accepted-tokens-per-weight-read, not the budget
-    # mix (the baseline legs above keep the mixed-budget turnover workload)
-    tok_s_s, engine_s = run_once(
-        quant=True, run_budgets=[N] * n_req, spec_k=4, prefill_chunk=P // 2
+    # compile ledger across all three legs (graftcheck-rt): each leg's first
+    # pass is its warmup, the measured pass must be zero-recompile — the
+    # promise the committed graftcheck-rt-budget.json pins
+    watcher = CompileWatcher().install()
+    try:
+        tok_s, engine = run_once(quant=False, watcher=watcher)
+        out["serving_new_tok_s"] = round(tok_s, 1)
+        tok_s_q, engine_q = run_once(quant=True, watcher=watcher)
+        out["serving_new_tok_s_int8kv"] = round(tok_s_q, 1)
+        # the spec leg runs every request at the full decode budget: a 2-token
+        # budget caps that slot's lifetime multiplier by construction, and the
+        # leg exists to measure accepted-tokens-per-weight-read, not the budget
+        # mix (the baseline legs above keep the mixed-budget turnover workload)
+        tok_s_s, engine_s = run_once(
+            quant=True, run_budgets=[N] * n_req, spec_k=4, prefill_chunk=P // 2,
+            watcher=watcher,
+        )
+        out["serving_new_tok_s_spec"] = round(tok_s_s, 1)
+    finally:
+        watcher.uninstall()
+    ledger = watcher.ledger()
+    out["compile_ledger"] = ledger
+    out["serving_compile_count_steady"] = int(
+        sum(led["steady_compiles"] for led in ledger.values())
     )
-    out["serving_new_tok_s_spec"] = round(tok_s_s, 1)
+    out["serving_compile_time_warmup_s"] = round(
+        sum(led["compile_time_warmup_s"] for led in ledger.values()), 4
+    )
 
     summary = engine_q.summary()
     out["serving_prefix_cache_hit_rate"] = round(summary["prefix_cache_hit_rate"], 4)

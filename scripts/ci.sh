@@ -91,6 +91,38 @@ echo "== graftcheck-ir budget gate (python -m trlx_tpu.analysis.ir)"
 # (TRLX_COMPILE_CACHE makes repeat runs cheap.)
 timeout -k 10 900 python -m trlx_tpu.analysis.ir
 
+echo "== analysis-rt tests (CPU)"
+# graftcheck-rt's own suite: SH001-SH004 positives/negatives (bucketing
+# ladders, weak-type float fields, unstable static args, data-dependent
+# shapes), noqa/baseline round-trips, watcher warmup-vs-steady attribution,
+# budget exit codes; the live repo-tree scan and probe runs are slow-marked
+JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_analysis_rt.py -q -m "not slow" -p no:cacheprovider
+
+echo "== graftcheck-rt compile-budget gate (python -m trlx_tpu.analysis.rt)"
+# the recompile gate: executes every registered compile probe (serving steps,
+# PPO/GRPO train steps, streamed scoring) on a virtual 8-device CPU mesh and
+# hard-fails when warmup compiles deviate from graftcheck-rt-budget.json or
+# ANY steady-state recompile appears — the steady-state budget is zero by
+# construction, not a tunable. The SH static rules already ran in the
+# full-rule graftcheck pass above, so this leg is probes-only. An INTENDED
+# warmup change is committed by regenerating the budget:
+#   python -m trlx_tpu.analysis.rt --write-budget   # then commit the diff
+timeout -k 10 900 python -m trlx_tpu.analysis.rt --exec-only
+
+echo "== rt seeded shape-churn gate (must fail on the seeded regression)"
+# the rt gate proves itself the way the conc/IR gates do: the same probe
+# command must exit non-zero when TRLX_RT_SEED_REGRESSION=shape_churn
+# disables the streamed-scoring bucket ladder in memory, so every response
+# length traces a fresh program — a zero-recompile gate that cannot catch
+# shape churn is not a gate
+if TRLX_RT_SEED_REGRESSION=shape_churn timeout -k 10 900 \
+    python -m trlx_tpu.analysis.rt --exec-only --probe stream_score_bucket > /dev/null 2>&1; then
+    echo "FATAL: seeded shape_churn regression was NOT caught by the rt compile-budget gate" >&2
+    exit 1
+fi
+echo "seeded shape_churn correctly rejected"
+
 echo "== resilience tests (CPU)"
 # checkpoint atomicity, preemption, auto-resume, retry, chaos; the budget is
 # wider than the other suites because the preemption/resume contract is proven

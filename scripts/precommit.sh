@@ -1,20 +1,26 @@
 #!/usr/bin/env bash
 # Fast local pre-commit: lint + graftcheck on CHANGED .py files only.
 #
-#   bash scripts/precommit.sh [BASE] [--select RULES]
+#   bash scripts/precommit.sh [BASE] [--select RULES] [--suite SUITE]
 #
 # BASE defaults to HEAD: staged + unstaged + untracked changes are checked.
 # Pass a ref (e.g. main) to check everything that differs from that ref.
-# --select RULES (comma-separated, e.g. --select JX005,JX008 — a prefix like
-# CC selects the whole family) is passed through to graftcheck to run one
-# rule family while iterating on a fix; without it every registered rule
-# (JX/TH/CC) runs on the changed files.
-# Full-tree equivalents run in scripts/ci.sh; this is the seconds-fast loop.
+# Both analysis flags route through the unified driver
+# (python -m trlx_tpu.analysis, docs/static-analysis.md):
+#   --select RULES  comma-separated, e.g. --select JX005,JX008 — a prefix
+#                   like CC selects the whole family — to run one rule family
+#                   while iterating on a fix
+#   --suite SUITE   ast|conc|rt|ir|all — e.g. --suite rt runs the SH rules
+#                   plus the compile-budget probes (minutes, not seconds)
+# Without either, every registered static rule (JX/TH/CC/SH) runs on the
+# changed files — the seconds-fast loop. Full-tree equivalents plus the
+# rt/ir execution gates run in scripts/ci.sh.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 BASE="HEAD"
 SELECT=""
+SUITE=""
 while [[ $# -gt 0 ]]; do
     case "$1" in
         --select)
@@ -23,6 +29,14 @@ while [[ $# -gt 0 ]]; do
             ;;
         --select=*)
             SELECT="${1#--select=}"
+            shift
+            ;;
+        --suite)
+            SUITE="${2:?--suite needs ast|conc|rt|ir|all}"
+            shift 2
+            ;;
+        --suite=*)
+            SUITE="${1#--suite=}"
             shift
             ;;
         *)
@@ -60,8 +74,9 @@ python scripts/lint.py "${files[@]}"
 echo "== graftcheck"
 # baseline keys are repo-root-relative (the same paths ci.sh uses), so the
 # committed baseline applies unchanged to a partial file list
-select_args=()
-[[ -n "$SELECT" ]] && select_args=(--select "$SELECT")
-JAX_PLATFORMS=cpu python -m trlx_tpu.analysis "${files[@]}" "${select_args[@]}"
+analysis_args=()
+[[ -n "$SELECT" ]] && analysis_args+=(--select "$SELECT")
+[[ -n "$SUITE" ]] && analysis_args+=(--suite "$SUITE")
+JAX_PLATFORMS=cpu python -m trlx_tpu.analysis "${files[@]}" "${analysis_args[@]}"
 
 echo "precommit OK"

@@ -41,6 +41,15 @@ IR003   large trace-time constant baked into the compiled graph
 IR004   host round-trip (callback/infeed/outfeed) in a hot step
 IR005   per-step collective census deviates from the committed budget
 IR006   compiled memory accounting deviates from the committed budget
+SH001   shape-polymorphic jit call site: a len()-derived dimension
+        reaches a jitted callable without a registered bucketing
+        ladder (analysis/rt/contracts.py)
+SH002   weak-type drift: a Python float reaches a jitted operand,
+        splitting the jit cache on weak_type
+SH003   unstable static_argnums/static_argnames value (float, dict,
+        fresh lambda) churning the jit cache
+SH004   data-dependent output shape under jit (nonzero, boolean-mask
+        indexing, traced-value slice bounds)
 ======  ==============================================================
 
 Tracedness (JX002-JX004) is resolved over a cross-module import-aware
@@ -75,6 +84,7 @@ from trlx_tpu.analysis.core import (  # noqa: F401
 from trlx_tpu.analysis import rules_jax, rules_spmd, rules_threads  # noqa: F401
 from trlx_tpu.analysis.conc import rules_conc  # noqa: F401  (registers CC001-CC005)
 from trlx_tpu.analysis.ir import rules_ir  # noqa: F401  (registers IR001-IR006)
+from trlx_tpu.analysis.rt import rules_rt  # noqa: F401  (registers SH001-SH004)
 
 __all__ = [
     "Finding",

@@ -1,10 +1,26 @@
-"""graftcheck command line.
+"""graftcheck command line — the unified driver for every analysis suite.
 
 Usage::
 
     python -m trlx_tpu.analysis PATH [PATH...] [options]
 
 Options:
+    --suite NAME         which analyzer(s) to run:
+                           ast   static JX/TH rules (in process)
+                           conc  static CC rules (in process)
+                           rt    graftcheck-rt: SH rules + compile probes vs
+                                 graftcheck-rt-budget.json (subprocess — the
+                                 probes must pin virtual CPU devices before
+                                 jax initializes)
+                           ir    graftcheck-ir: AOT lowering vs
+                                 graftcheck-ir-budget.json (subprocess, same
+                                 reason)
+                           all   every static rule in process, then the rt
+                                 probes and the ir gate as subprocesses;
+                                 exit status is the worst of the three
+                         Without --suite every *static* rule (JX/TH/CC/SH)
+                         runs in process — the historical behavior, and what
+                         scripts/precommit.sh uses for the seconds-fast loop.
     --baseline FILE      baseline file (default: graftcheck-baseline.txt,
                          resolved against the current directory)
     --no-baseline        ignore the baseline (report every finding as new)
@@ -13,23 +29,57 @@ Options:
     --prune-baseline     drop stale baseline entries (keeping comments and
                          justifications verbatim) and exit 0
     --select R1,R2       run only the listed rule ids; a prefix selects the
-                         whole family (--select CC = CC001..CC005)
+                         whole family (--select CC = CC001..CC005); overrides
+                         a suite's default rule family
     --jobs N             check files on N forked workers (parse + call graph
                          + conc model stay in the parent, inherited CoW);
                          N<=1 or platforms without fork run serially
     --list-rules         print the rule registry and exit
 
-Exit status: 1 if any *new* finding (not noqa'd, not baselined), else 0 —
-this is the contract ``scripts/ci.sh`` gates on.
+Exit status: 1 if any *new* finding (not noqa'd, not baselined) or, for the
+rt/ir suites, any budget violation; else 0 — this is the contract
+``scripts/ci.sh`` gates on.
 """
 
 import argparse
+import subprocess
 import sys
 
 from trlx_tpu.analysis import baseline as baseline_mod
 from trlx_tpu.analysis.core import RULES, resolve_select, run
 
 DEFAULT_BASELINE = "graftcheck-baseline.txt"
+
+# suite -> default --select for the in-process static pass (None = every rule)
+SUITE_SELECTS = {"ast": "JX,TH", "conc": "CC"}
+
+
+def _run_subprocess_suite(module: str, extra_argv) -> int:
+    """Run an analyzer that must own process initialization (rt/ir pin
+    virtual CPU devices before jax touches a backend) as ``python -m``."""
+    cmd = [sys.executable, "-m", module] + list(extra_argv)
+    return subprocess.call(cmd)
+
+
+def _rt_argv(args, exec_only: bool = False):
+    argv = list(args.paths or ["trlx_tpu"])
+    if args.select:
+        argv += ["--select", args.select]
+    argv += ["--jobs", str(args.jobs)]
+    if args.baseline != DEFAULT_BASELINE:
+        argv += ["--baseline", args.baseline]
+    if args.no_baseline:
+        argv += ["--no-baseline"]
+    if exec_only:
+        argv += ["--exec-only"]
+    return argv
+
+
+def _ir_argv(args):
+    argv = []
+    if args.no_baseline:
+        argv += ["--no-baseline"]
+    return argv
 
 
 def main(argv=None) -> int:
@@ -38,6 +88,12 @@ def main(argv=None) -> int:
         description="graftcheck: JAX- and concurrency-aware static analysis",
     )
     parser.add_argument("paths", nargs="*", default=["trlx_tpu"])
+    parser.add_argument(
+        "--suite",
+        choices=["ast", "conc", "rt", "ir", "all"],
+        default=None,
+        help="analyzer suite(s) to run; omit for every static rule in process",
+    )
     parser.add_argument("--baseline", default=DEFAULT_BASELINE)
     parser.add_argument("--no-baseline", action="store_true")
     parser.add_argument("--write-baseline", action="store_true")
@@ -50,11 +106,29 @@ def main(argv=None) -> int:
     # populate the registry for --list-rules before any file is scanned
     from trlx_tpu.analysis import rules_jax, rules_spmd, rules_threads  # noqa: F401
     from trlx_tpu.analysis.conc import rules_conc  # noqa: F401
+    from trlx_tpu.analysis.rt import rules_rt  # noqa: F401
 
     if args.list_rules:
         for rid in sorted(RULES):
             print(f"{rid}  {RULES[rid].summary}")
         return 0
+
+    if args.suite in ("rt", "ir") and (args.write_baseline or args.prune_baseline):
+        print(
+            "graftcheck: --write-baseline/--prune-baseline apply to the static "
+            "rules; run them without --suite (or with --suite ast/conc)",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.suite == "rt":
+        # the probes execute jitted steps on a pinned virtual-device mesh, so
+        # the whole suite (static SH pass included) runs as its own process
+        return _run_subprocess_suite("trlx_tpu.analysis.rt", _rt_argv(args))
+    if args.suite == "ir":
+        return _run_subprocess_suite("trlx_tpu.analysis.ir", _ir_argv(args))
+    if args.suite in ("ast", "conc") and not args.select:
+        args.select = SUITE_SELECTS[args.suite]
 
     select = None
     if args.select:
@@ -105,7 +179,14 @@ def main(argv=None) -> int:
         f"graftcheck: {len(findings)} finding(s) "
         f"({len(new)} new, {n_baselined} baselined, {len(stale)} stale baseline)"
     )
-    return 1 if new else 0
+    rc = 1 if new else 0
+
+    if args.suite == "all":
+        # the static pass above already ran every rule family (SH included),
+        # so the rt subprocess runs probes-only; ir lowers its own entrypoints
+        rc = max(rc, _run_subprocess_suite("trlx_tpu.analysis.rt", _rt_argv(args, exec_only=True)))
+        rc = max(rc, _run_subprocess_suite("trlx_tpu.analysis.ir", _ir_argv(args)))
+    return rc
 
 
 if __name__ == "__main__":

@@ -228,6 +228,7 @@ def run(paths: Sequence, select: Optional[Sequence[str]] = None, jobs: int = 1) 
     # alone is enough to get the full registry
     from trlx_tpu.analysis import rules_jax, rules_spmd, rules_threads  # noqa: F401
     from trlx_tpu.analysis.conc import rules_conc  # noqa: F401
+    from trlx_tpu.analysis.rt import rules_rt  # noqa: F401
     from trlx_tpu.analysis.callgraph import Project
     from trlx_tpu.analysis.conc import model as conc_model, seeds as conc_seeds
 

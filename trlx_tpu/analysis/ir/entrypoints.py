@@ -103,6 +103,7 @@ def load_all() -> Dict[str, EntryPoint]:
     """Import every module that registers hot entrypoints and return the
     registry. The import list is the audit surface — a new hot step means a
     new line here plus a ``@register_entrypoint`` at its definition site."""
+    import trlx_tpu.methods.grpo  # noqa: F401
     import trlx_tpu.methods.ilql  # noqa: F401
     import trlx_tpu.methods.ppo  # noqa: F401
     import trlx_tpu.ops.generation  # noqa: F401

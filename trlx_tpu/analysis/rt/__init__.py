@@ -1,0 +1,21 @@
+"""graftcheck-rt: recompile & shape-stability analysis.
+
+The fourth graftcheck suite. Static rules SH001–SH004
+(:mod:`trlx_tpu.analysis.rt.rules_rt`) flag the source patterns that
+silently multiply jit compiles — shape-polymorphic call sites, weak-type
+drift, unstable statics, data-dependent output shapes. The runtime side
+(:mod:`trlx_tpu.analysis.rt.watcher`, :mod:`trlx_tpu.analysis.rt.probes`)
+measures actual compiles per registered entrypoint and gates steady state to
+**zero** against the committed ``graftcheck-rt-budget.json``
+(:mod:`trlx_tpu.analysis.rt.budget`).
+
+Run: ``python -m trlx_tpu.analysis.rt PATH... [--baseline/--write-budget]``,
+or through the unified driver ``python -m trlx_tpu.analysis --suite rt``.
+
+This ``__init__`` stays import-light on purpose: production modules (the PPO
+trainer, the serving engine) import :mod:`trlx_tpu.analysis.rt.contracts`
+and :mod:`trlx_tpu.analysis.rt.seeds` at module scope, and the watcher is
+imported from hot paths — none of that may pull in the rules machinery or
+jax. Rules register when :func:`trlx_tpu.analysis.core.run` (or the rt CLI)
+imports :mod:`rules_rt`.
+"""

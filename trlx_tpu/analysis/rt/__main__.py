@@ -1,0 +1,15 @@
+"""Entry: force virtual CPU devices (the probes execute the same sharded
+train-step artifacts graftcheck-ir lowers, so they need the same 8-device
+virtual mesh), then run the rt gate. Same recipe as ``-m trlx_tpu.analysis.ir``
+— the device count must be pinned before jax initializes a backend."""
+
+import os
+import sys
+
+from trlx_tpu.analysis.ir.__main__ import _force_cpu
+
+if __name__ == "__main__":
+    _force_cpu(int(os.environ.get("TRLX_RT_DEVICES", os.environ.get("TRLX_IR_DEVICES", "8"))))
+    from trlx_tpu.analysis.rt.cli import main
+
+    sys.exit(main())

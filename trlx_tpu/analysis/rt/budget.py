@@ -1,0 +1,100 @@
+"""The committed compile budget: ``graftcheck-rt-budget.json``.
+
+Per runtime probe (:mod:`trlx_tpu.analysis.rt.probes`) the budget commits the
+expected *warmup* compile count — exact, because a silently-appearing extra
+warmup compile is a new jit-cache family — and pins *steady-state* compiles to
+**zero**. Steady state is not a committed number that can be regenerated
+upward: ``compare`` treats any nonzero steady count as a violation even when
+the committed file says otherwise, so the zero-recompile promise cannot be
+waived by re-running ``--write-budget``.
+
+Like ``graftcheck-ir-budget.json`` (and unlike the findings baseline),
+deviations are always failures; the only path to new warmup numbers is
+``python -m trlx_tpu.analysis.rt --write-budget`` plus a committed diff a
+reviewer sees.
+"""
+
+import json
+import os
+from pathlib import Path
+from typing import Any, Dict, List, Tuple
+
+DEFAULT_BUDGET = "graftcheck-rt-budget.json"
+
+SEED_ENV = "TRLX_RT_SEED_REGRESSION"
+
+
+def load(path) -> Dict[str, Any]:
+    p = Path(path)
+    if not p.exists():
+        return {}
+    doc = json.loads(p.read_text())
+    return {k: v for k, v in doc.items() if not k.startswith("_")}
+
+
+def write(path, measurements: Dict[str, Dict[str, Any]]) -> int:
+    """Write the committed budget. Refuses under a seeded regression — a
+    budget regenerated while the seed is active would commit the defect."""
+    if os.environ.get(SEED_ENV):
+        raise RuntimeError(
+            f"refusing --write-budget while {SEED_ENV}="
+            f"{os.environ[SEED_ENV]!r} is set: the seeded defect would be "
+            f"committed as the expected profile"
+        )
+    doc: Dict[str, Any] = {
+        "_format": (
+            "per-probe compile budget: warmup_compiles exact, steady_compiles "
+            "pinned to zero regardless of this file's contents (see "
+            "trlx_tpu/analysis/rt/budget.py)"
+        ),
+        "_regenerate": "python -m trlx_tpu.analysis.rt --write-budget",
+    }
+    for key in sorted(measurements):
+        entry = dict(measurements[key])
+        # never commit a nonzero steady count, even if measured: the written
+        # file documents the contract, compare() enforces the measurement
+        entry["steady_compiles"] = 0
+        doc[key] = entry
+    Path(path).write_text(json.dumps(doc, indent=1, sort_keys=False) + "\n")
+    return len(measurements)
+
+
+def compare(
+    measurements: Dict[str, Dict[str, Any]], budget: Dict[str, Any]
+) -> Tuple[List[str], List[str]]:
+    """(violations, notes). Violations: nonzero steady-state compiles
+    (always, budget notwithstanding), warmup drift from the committed exact
+    count, probes with no committed entry. Only probes present in
+    ``measurements`` are compared, so a ``--probe`` subset run never
+    complains about probes it did not execute."""
+    violations: List[str] = []
+    notes: List[str] = []
+    for key in sorted(measurements):
+        got = measurements[key]
+        steady = int(got.get("steady_compiles", 0))
+        if steady != 0:
+            violations.append(
+                f"RT001 {key}: {steady} steady-state compile(s) — the "
+                f"zero-recompile promise is broken (an unbucketed shape, "
+                f"weak-type drift, or an unstable static reached this "
+                f"entrypoint after warmup)"
+            )
+        want = budget.get(key)
+        if want is None:
+            violations.append(
+                f"RT002 {key}: no committed budget entry — run "
+                f"--write-budget and commit the result"
+            )
+            continue
+        gw, ww = int(got.get("warmup_compiles", 0)), int(want.get("warmup_compiles", 0))
+        if gw > ww:
+            violations.append(
+                f"RT002 {key}: warmup compiles {ww} -> {gw} — a new jit-cache "
+                f"family appeared; if intended, regenerate the budget"
+            )
+        elif gw < ww:
+            notes.append(
+                f"RT002 {key}: warmup compiles improved {ww} -> {gw} "
+                f"(regenerate to lock in)"
+            )
+    return violations, notes

@@ -1,0 +1,109 @@
+"""graftcheck-rt command line.
+
+Usage::
+
+    python -m trlx_tpu.analysis.rt [PATH...] [options]
+
+Two gates in one exit code:
+
+1. **Static**: the SH001–SH004 rules over ``PATH...`` (default: the package
+   tree), with the shared noqa/baseline machinery — delegated to the main
+   graftcheck CLI with ``--select SH`` so semantics (stale filtering, subset
+   runs, ``--jobs``) are identical to every other suite.
+2. **Runtime**: the compile probes (:mod:`trlx_tpu.analysis.rt.probes`)
+   against the committed ``graftcheck-rt-budget.json`` — warmup compiles
+   exact, steady-state compiles must be zero.
+
+Options:
+    --baseline FILE      findings baseline (default: graftcheck-baseline.txt)
+    --no-baseline        report every static finding as new
+    --select R1,R2       restrict the static rules (default: the SH family)
+    --jobs N             process-parallel static checking
+    --budget FILE        compile budget (default: graftcheck-rt-budget.json)
+    --write-budget       regenerate the budget from fresh probe runs, exit 0
+                         (refused while TRLX_RT_SEED_REGRESSION is set)
+    --probe N1,N2        run only the named probes (budget compare covers
+                         exactly the probes that ran)
+    --no-exec            static rules only (skip the probes)
+    --exec-only          probes only (skip the static rules)
+
+Exit status: 1 on any new static finding or budget violation, else 0 —
+the contract ``scripts/ci.sh`` gates on. NOTE: the probes execute jitted
+steps; run via ``python -m trlx_tpu.analysis.rt`` (which forces virtual CPU
+devices before jax initializes) rather than importing this module into a
+process already holding a backend.
+"""
+
+import argparse
+import sys
+
+from trlx_tpu.analysis.rt import budget as budget_mod
+
+DEFAULT_SELECT = "SH"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m trlx_tpu.analysis.rt",
+        description="graftcheck-rt: recompile & shape-stability analysis",
+    )
+    parser.add_argument("paths", nargs="*", default=["trlx_tpu"])
+    parser.add_argument("--baseline", default=None)
+    parser.add_argument("--no-baseline", action="store_true")
+    parser.add_argument("--select", default=DEFAULT_SELECT)
+    parser.add_argument("--jobs", type=int, default=1)
+    parser.add_argument("--budget", default=budget_mod.DEFAULT_BUDGET)
+    parser.add_argument("--write-budget", action="store_true")
+    parser.add_argument("--probe", default=None, help="comma-separated probe names")
+    parser.add_argument("--no-exec", action="store_true")
+    parser.add_argument("--exec-only", action="store_true")
+    args = parser.parse_args(argv)
+
+    rc = 0
+    if not args.exec_only:
+        from trlx_tpu.analysis.cli import main as ast_main
+
+        static_argv = list(args.paths) + ["--select", args.select, "--jobs", str(args.jobs)]
+        if args.baseline:
+            static_argv += ["--baseline", args.baseline]
+        if args.no_baseline:
+            static_argv += ["--no-baseline"]
+        rc = max(rc, ast_main(static_argv))
+
+    if args.no_exec:
+        return rc
+
+    from trlx_tpu.analysis.rt.probes import run_probes
+
+    names = None
+    if args.probe:
+        names = [p.strip() for p in args.probe.split(",") if p.strip()]
+    try:
+        measurements, ledger = run_probes(names, verbose=True)
+    except ValueError as e:
+        print(f"graftcheck-rt: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_budget:
+        n = budget_mod.write(args.budget, measurements)
+        print(f"graftcheck-rt: wrote {n} budget entries to {args.budget}")
+        return rc
+
+    committed = budget_mod.load(args.budget)
+    violations, notes = budget_mod.compare(measurements, committed)
+    for v in violations:
+        print(v)
+    for n in notes:
+        print(f"note: {n}")
+    warm = sum(m["warmup_compiles"] for m in measurements.values())
+    steady = sum(m["steady_compiles"] for m in measurements.values())
+    print(
+        f"graftcheck-rt: {len(measurements)} entrypoint(s) probed, "
+        f"{warm} warmup compile(s), {steady} steady-state compile(s), "
+        f"{len(violations)} violation(s)"
+    )
+    return 1 if violations else rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,237 @@
+"""Runtime compile probes: execute the real hot paths and count compiles.
+
+Each probe drives *production* code — the actual ``ServingEngine`` jitted
+steps, the actual ``ppo_train_step``/``grpo_train_step`` audit artifacts, the
+actual streamed-scoring quantizer — under a :class:`CompileWatcher` through a
+warmup pass and then a steady-state pass whose inputs differ in *content* but
+not in *bucketed shape*. The measured counts gate against the committed
+``graftcheck-rt-budget.json``: warmup exact, steady **zero**.
+
+Probes run on forced virtual CPU devices (``python -m trlx_tpu.analysis.rt``
+pins the platform before jax imports, the graftcheck-ir recipe) so the gate
+costs compile time, not TPU time. Determinism: every probe feeds fixed
+prompts/shapes and greedy decoding, so the warmup compile census is a stable
+number a budget can pin.
+
+``TRLX_RT_SEED_REGRESSION=shape_churn`` corrupts the streamed-scoring
+quantizer (see :mod:`trlx_tpu.analysis.rt.seeds`): the ``stream_score_bucket``
+probe's steady pass then presents raw unbucketed lengths, steady compiles go
+nonzero, and the gate must exit 1 — ci.sh proves it.
+"""
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from trlx_tpu.analysis.rt.watcher import CompileWatcher
+
+#: probe name -> runner; ordered. Each runner returns the entry names it
+#: measured (the budget keys it owns).
+PROBES: Dict[str, Callable[[CompileWatcher], List[str]]] = {}
+
+
+def _probe(name):
+    def deco(fn):
+        PROBES[name] = fn
+        return fn
+
+    return deco
+
+
+def probe_names() -> Tuple[str, ...]:
+    return tuple(PROBES)
+
+
+# -- serving engine -----------------------------------------------------------
+
+#: the tiny CPU model every serving probe drives (mirrors tests/test_serving)
+_TINY = dict(
+    vocab_size=37, hidden_size=16, num_layers=2, num_heads=2,
+    max_position_embeddings=64,
+)
+
+#: fixed prompt-length profile; the steady batch reuses the lengths with
+#: different token values, so every shape maps onto an already-compiled bucket
+_PROMPT_LENS = (3, 12, 7, 2, 5)
+_MAX_NEW = 6
+
+
+def _tiny_model_and_params():
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.models.presets import PRESETS
+    from trlx_tpu.models.transformer import TransformerLM
+
+    config = PRESETS["gpt2"].replace(compute_dtype=jnp.float32, **_TINY)
+    model = TransformerLM(config)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 4), jnp.int32), jnp.ones((1, 4), jnp.int32)
+    )["params"]
+    return model, params, config
+
+
+def _prompt_batch(seed: int):
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    return [
+        np.asarray(rng.randint(1, _TINY["vocab_size"], size=n), np.int32)
+        for n in _PROMPT_LENS
+    ]
+
+
+@_probe("serving_engine")
+def run_serving_engine(watcher: CompileWatcher) -> List[str]:
+    """Decode/prefill/pack through a plain engine, verify/chunked-prefill
+    through a speculative one — both warmed on batch 1, then required to
+    serve batch 2 (same length profile, fresh tokens) with zero compiles."""
+    from trlx_tpu.serving import GenerationClient, ServingEngine
+
+    model, params, _ = _tiny_model_and_params()
+
+    def build(spec_k: int, prefill_chunk: int) -> ServingEngine:
+        return ServingEngine(
+            model, params, num_slots=3, max_seq_len=32, block_size=4,
+            eos_token_id=None, pad_token_id=0,
+            gen_kwargs=dict(do_sample=False), seed=0,
+            spec_k=spec_k, prefill_chunk=prefill_chunk,
+        )
+
+    plain = build(spec_k=0, prefill_chunk=0)
+    spec = build(spec_k=2, prefill_chunk=4)
+    watcher.track("serving_decode_step", plain._decode_step)
+    watcher.track("serving_prefill", plain._prefill)
+    watcher.track("serving_prefill", spec._prefill)
+    watcher.track("serving_pack_step", plain._pack)
+    watcher.track("serving_pack_step", spec._pack)
+    watcher.track("serving_verify_step", spec._verify_step)
+    watcher.track("serving_chunk_step", spec._chunk_step)
+    entries = [
+        "serving_decode_step", "serving_prefill", "serving_pack_step",
+        "serving_verify_step", "serving_chunk_step",
+    ]
+
+    for eng in (plain, spec):
+        GenerationClient(eng).generate_batch(_prompt_batch(seed=0), _MAX_NEW)
+    for name in entries:
+        watcher.mark_steady(name)
+    for eng in (plain, spec):
+        GenerationClient(eng).generate_batch(_prompt_batch(seed=1), _MAX_NEW)
+    return entries
+
+
+# -- train steps --------------------------------------------------------------
+
+
+def _materialize(tree):
+    """Zeros for every abstract leaf, placed per its declared sharding — the
+    probes execute the same artifacts graftcheck-ir only lowers."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(
+        lambda s: jax.device_put(jnp.zeros(s.shape, s.dtype), s.sharding), tree
+    )
+
+
+def _run_train_step(watcher: CompileWatcher, entry_name: str) -> List[str]:
+    import jax
+
+    from trlx_tpu.analysis.ir.entrypoints import load_all
+    from trlx_tpu.parallel.mesh import make_deviceless_mesh
+
+    ep = load_all()[entry_name]
+    mesh = make_deviceless_mesh(**ep.mesh_shape)
+    art = ep.builder("small", mesh)
+    jitted = jax.jit(art.fn, donate_argnums=art.donate_argnums)
+    watcher.track(entry_name, jitted)
+    with watcher.attributed(entry_name):
+        # donation invalidates the warmup args; each pass materializes fresh
+        with mesh:
+            jax.block_until_ready(jitted(*_materialize(art.args)))
+        watcher.mark_steady(entry_name)
+        with mesh:
+            jax.block_until_ready(jitted(*_materialize(art.args)))
+    return [entry_name]
+
+
+@_probe("ppo_train_step")
+def run_ppo_train_step(watcher: CompileWatcher) -> List[str]:
+    return _run_train_step(watcher, "ppo_train_step")
+
+
+@_probe("grpo_train_step")
+def run_grpo_train_step(watcher: CompileWatcher) -> List[str]:
+    return _run_train_step(watcher, "grpo_train_step")
+
+
+# -- streamed scoring quantizer -----------------------------------------------
+
+#: raw completion lengths covering each ladder bucket once (warmup) and then
+#: re-hitting only already-compiled buckets (steady). With max_new=64 the
+#: ladder is [16, 32, 64, 128]; the raw values are deliberately NOT bucket
+#: values — the quantizer must do that work.
+_WARMUP_LENS = (5, 20, 50, 100)
+_STEADY_LENS = (7, 25, 60, 90, 13)
+_STREAM_MAX_NEW = 64
+
+
+@_probe("stream_score_bucket")
+def run_stream_score_bucket(watcher: CompileWatcher) -> List[str]:
+    """The real streamed-scoring ladder (``overlap_r_buckets`` +
+    ``quantize_stream_response``, trainer/ppo_trainer.py) in front of a jitted
+    score fn: one compile per ladder bucket at warmup, zero after. Under
+    ``TRLX_RT_SEED_REGRESSION=shape_churn`` the quantizer leaks raw lengths
+    and the steady pass recompiles — the defect this gate exists to catch."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from trlx_tpu.trainer.ppo_trainer import overlap_r_buckets, quantize_stream_response
+
+    ladder = overlap_r_buckets(_STREAM_MAX_NEW)
+
+    @jax.jit
+    def score(x):
+        return jnp.sum(x * 2.0, dtype=jnp.float32)
+
+    watcher.track("stream_score_bucket", score)
+    with watcher.attributed("stream_score_bucket"):
+        for r in _WARMUP_LENS:
+            R = quantize_stream_response(r, ladder)
+            jax.block_until_ready(score(jnp.zeros((1, R), jnp.float32)))
+        watcher.mark_steady("stream_score_bucket")
+        for r in _STEADY_LENS:
+            R = quantize_stream_response(r, ladder)
+            jax.block_until_ready(score(jnp.zeros((1, R), jnp.float32)))
+    return ["stream_score_bucket"]
+
+
+# -- driver -------------------------------------------------------------------
+
+
+def run_probes(
+    names: Optional[List[str]] = None, verbose: bool = False
+) -> Tuple[Dict[str, Dict[str, int]], Dict[str, Dict[str, float]]]:
+    """Run the selected probes under one watcher. Returns ``(measurements,
+    ledger)``: measurements is the budget-facing record (tracked compile
+    counts only — exact and machine-independent), ledger is the full
+    per-entry journal including monitoring-event compile durations."""
+    selected = list(names) if names else list(PROBES)
+    unknown = [n for n in selected if n not in PROBES]
+    if unknown:
+        raise ValueError(f"unknown probe(s) {unknown}; known: {list(PROBES)}")
+    measured: List[str] = []
+    with CompileWatcher() as watcher:
+        for name in selected:
+            if verbose:
+                print(f"[graftcheck-rt] probe {name}...")
+            measured.extend(PROBES[name](watcher))
+        ledger = watcher.ledger()
+    measurements = {
+        name: {
+            "warmup_compiles": int(ledger[name]["warmup_compiles"]),
+            "steady_compiles": int(ledger[name]["steady_compiles"]),
+        }
+        for name in measured
+    }
+    return measurements, ledger

@@ -1,0 +1,684 @@
+"""graftcheck-rt static rules: recompile & shape-stability discipline.
+
+SH001  shape-polymorphic jit call sites — an argument whose shape derives
+       from ``len()``/list growth/a varying Python int reaches a jitted
+       callable without passing through a registered bucketing ladder
+       (:mod:`trlx_tpu.analysis.rt.contracts`). Every distinct shape is a
+       full recompile; a ragged stream of lengths is a compile storm.
+SH002  weak-type / dtype-promotion drift — a Python float (literal,
+       ``float(...)`` conversion, or a name bound to one) reaches a jitted
+       operand. The scalar traces as a ``weak_type`` f32, so the jit cache
+       splits against any strongly-typed caller of the same site and every
+       mixed-promotion seam downstream. Fix: ``jnp.asarray(x, dtype)`` at
+       the boundary.
+SH003  unstable statics — a value marked static (``static_argnums``/
+       ``static_argnames``) that churns the cache: a float (value-keyed
+       cache, one compile per distinct value), a dict/list/set display
+       (unhashable: TypeError at best), or a fresh lambda/closure (new
+       object identity every call: one compile per call).
+SH004  data-dependent output shapes under jit — ``nonzero``/``argwhere``/
+       ``unique``, single-argument ``where``, boolean-mask indexing, and
+       slice bounds computed from traced reductions. These either fail to
+       trace or force a host sync + recompile per distinct outcome; the fix
+       is the fixed-shape idiom (``jnp.where(mask, x, 0)``, ``size=`` +
+       ``fill_value=``, or masks carried to the reduction).
+
+SH001/SH002/SH003 reason about *call sites of jitted callables*: names bound
+via ``f = jax.jit(...)`` / ``self._step = jax.jit(...)``, defs decorated with
+``@jit``/``@partial(jax.jit, ...)``, and (via the PR-5 call graph) functions
+jit-wrapped from another module. SH004 reasons about *traced bodies* (the
+same project-wide traced set the JX rules use). All flow reasoning is
+CFG-lite source order, the framework contract (see ``core``).
+"""
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from trlx_tpu.analysis import astutils
+from trlx_tpu.analysis.astutils import collect_aliases, dotted
+from trlx_tpu.analysis.core import FileContext, Finding, Rule, register
+from trlx_tpu.analysis.rt import contracts
+
+#: array constructors whose first argument is a shape
+_SHAPE_CTORS = frozenset({"zeros", "ones", "full", "empty", "arange", "tile", "broadcast_to"})
+
+#: conventional roots a shape ctor hangs off (``jnp.zeros``, ``np.full``);
+#: resolving the exact module alias buys little here — a ``zeros()`` from any
+#: array library has the same recompile consequence
+_ARRAY_ROOTS = frozenset({"jnp", "np", "numpy", "jax", "jax.numpy"})
+
+#: jnp/np reductions producing a traced scalar; using one as a slice bound
+#: inside trace is a data-dependent shape (SH004)
+_TRACED_REDUCTIONS = frozenset({"sum", "max", "min", "argmax", "argmin", "count_nonzero"})
+
+#: calls whose OUTPUT shape depends on data values (SH004)
+_DATA_DEP_CALLS = frozenset({"nonzero", "flatnonzero", "argwhere", "unique"})
+
+
+def _is_len_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "len"
+    )
+
+
+def _is_array_shape_ctor(call: ast.Call) -> bool:
+    d = dotted(call.func)
+    if d is None or "." not in d:
+        return False
+    base, attr = d.rsplit(".", 1)
+    return attr in _SHAPE_CTORS and (base in _ARRAY_ROOTS or base.split(".")[0] in _ARRAY_ROOTS)
+
+
+def _sanctioned_call_in(node: ast.AST, sanctioned_fns: frozenset) -> bool:
+    """True when the expression contains a call to a registered quantizer —
+    the len-derived value flowed through a bucketing ladder."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            d = dotted(sub.func)
+            if d is not None and d.split(".")[-1] in sanctioned_fns:
+                return True
+    return False
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class _JitTarget:
+    """One jitted callable visible in this file: how it is called and which
+    of its parameters are static."""
+
+    __slots__ = ("kind", "name", "static_argnums", "static_argnames", "node")
+
+    def __init__(self, kind, name, static_argnums=(), static_argnames=(), node=None):
+        self.kind = kind  # "name" (bare f(...)) | "attr" (self.f(...) / obj.f(...))
+        self.name = name
+        self.static_argnums = static_argnums
+        self.static_argnames = static_argnames
+        self.node = node
+
+
+def _static_info(jit_call: ast.Call) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+    """(static_argnums, static_argnames) literals from a jit wrap call —
+    handles ``jax.jit(f, static_argnums=...)`` and the keywords of
+    ``partial(jax.jit, static_argnums=...)``."""
+    nums: Tuple[int, ...] = ()
+    names: Tuple[str, ...] = ()
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnums":
+            vals = []
+            items = kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for it in items:
+                if isinstance(it, ast.Constant) and isinstance(it.value, int):
+                    vals.append(it.value)
+            nums = tuple(vals)
+        elif kw.arg == "static_argnames":
+            vals = []
+            items = kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for it in items:
+                if isinstance(it, ast.Constant) and isinstance(it.value, str):
+                    vals.append(it.value)
+            names = tuple(vals)
+    return nums, names
+
+
+def _decorator_static_info(fn: ast.AST, al) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+    for dec in getattr(fn, "decorator_list", []):
+        if isinstance(dec, ast.Call) and (
+            astutils.is_jit_ref(dec.func, al)
+            or (dec.args and astutils.is_jit_ref(dec.args[0], al))
+        ):
+            return _static_info(dec)
+    return (), ()
+
+
+def _collect_jit_targets(ctx: FileContext, al) -> List[_JitTarget]:
+    """Jitted callables addressable from this file: ``step = jax.jit(f)``
+    assignments (Name and ``self.x`` / ``obj.x`` Attribute targets) and
+    jit-decorated defs."""
+    out: List[_JitTarget] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            wrapped = astutils._jit_call_target(call, al)
+            if wrapped is None and not astutils.is_jit_ref(call.func, al):
+                continue
+            info_call = call if astutils.is_jit_ref(call.func, al) else call
+            # partial(jax.jit, ...)(f): statics live on the inner call
+            if isinstance(call.func, ast.Call):
+                info_call = call.func
+            nums, names = _static_info(info_call)
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.append(_JitTarget("name", tgt.id, nums, names, node))
+                elif isinstance(tgt, ast.Attribute):
+                    out.append(_JitTarget("attr", tgt.attr, nums, names, node))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if astutils._decorated_jit(node, al):
+                nums, names = _decorator_static_info(node, al)
+                out.append(_JitTarget("name", node.name, nums, names, node))
+    return out
+
+
+def _jit_call_sites(ctx: FileContext, targets: List[_JitTarget]):
+    """Yield (call, target) for every call of a known jitted callable."""
+    by_name = {t.name: t for t in targets if t.kind == "name"}
+    by_attr = {t.name: t for t in targets if t.kind == "attr"}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in by_name:
+            yield node, by_name[fn.id]
+        elif isinstance(fn, ast.Attribute) and fn.attr in by_attr:
+            yield node, by_attr[fn.attr]
+
+
+def _enclosing_scope_assigns(ctx: FileContext, call: ast.Call) -> List[ast.Assign]:
+    """Assignments textually preceding ``call`` in its enclosing function (or
+    module) scope — the CFG-lite flow window the SH rules reason over."""
+    if not hasattr(ctx, "_rt_parents"):
+        ctx._rt_parents = astutils.build_parents(ctx.tree)  # type: ignore[attr-defined]
+    parents = ctx._rt_parents  # type: ignore[attr-defined]
+    node = call
+    scope = ctx.tree
+    while node in parents:
+        node = parents[node]
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            scope = node
+            break
+    out = []
+    for sub in ast.walk(scope):
+        if isinstance(sub, ast.Assign) and getattr(sub, "lineno", 0) <= call.lineno:
+            out.append(sub)
+    out.sort(key=lambda a: a.lineno)
+    return out
+
+
+def _classify_scope_names(assigns: List[ast.Assign], sanctioned_fns: frozenset):
+    """(len_derived, sanctioned, poly_shaped, float_bound, lambda_bound) name
+    sets from the scope's assignments, in source order."""
+    len_derived: Set[str] = set()
+    sanctioned: Set[str] = set()
+    poly_shaped: Set[str] = set()
+    float_bound: Set[str] = set()
+    lambda_bound: Set[str] = set()
+    for a in assigns:
+        names = [t.id for t in a.targets if isinstance(t, ast.Name)]
+        if not names:
+            continue
+        v = a.value
+        if _sanctioned_call_in(v, sanctioned_fns):
+            sanctioned.update(names)
+            len_derived.difference_update(names)
+            poly_shaped.difference_update(names)
+            continue
+        is_len = any(_is_len_call(sub) for sub in ast.walk(v))
+        refs = _names_in(v)
+        if is_len or (refs & len_derived):
+            if not (refs & sanctioned) or is_len:
+                len_derived.update(names)
+        else:
+            len_derived.difference_update(names)
+        if isinstance(v, ast.Call) and _is_array_shape_ctor(v):
+            dims = _names_in(v)
+            if any(_is_len_call(sub) for sub in ast.walk(v)) or (dims & len_derived):
+                poly_shaped.update(names)
+            else:
+                poly_shaped.difference_update(names)
+        elif names:
+            poly_shaped.difference_update(names)
+        if isinstance(v, ast.Constant) and isinstance(v.value, float):
+            float_bound.update(names)
+        elif (
+            isinstance(v, ast.Call)
+            and isinstance(v.func, ast.Name)
+            and v.func.id == "float"
+        ):
+            float_bound.update(names)
+        else:
+            float_bound.difference_update(names)
+        if isinstance(v, ast.Lambda):
+            lambda_bound.update(names)
+        else:
+            lambda_bound.difference_update(names)
+    return len_derived, sanctioned, poly_shaped, float_bound, lambda_bound
+
+
+#: boundary-pin calls a float field may legitimately appear inside — they ARE
+#: the SH002 fix, so fixed code must not re-flag
+_DTYPE_PIN_CALLS = frozenset({"asarray", "array", "float32", "bfloat16", "float16"})
+
+
+def _is_float_annotation(ann: Optional[ast.AST]) -> bool:
+    if isinstance(ann, ast.Name):
+        return ann.id == "float"
+    if isinstance(ann, ast.Subscript):  # Optional[float]
+        base = dotted(ann.value)
+        if base is not None and base.split(".")[-1] == "Optional":
+            return _is_float_annotation(ann.slice)
+    return False
+
+
+def _float_fields_index(ctx: FileContext) -> Dict[str, Set[str]]:
+    """class name -> float-annotated field names, resolved project-wide when a
+    :class:`~trlx_tpu.analysis.callgraph.Project` is attached (so GRPOConfig
+    inherits ``cliprange`` from PPOConfig across files), else this file only.
+    Cached on the project object — one scan per run."""
+    project = ctx.project
+    if project is not None and hasattr(project, "_rt_float_fields"):
+        return project._rt_float_fields  # type: ignore[attr-defined]
+    trees = (
+        [m.ctx.tree for m in project.modules.values()] if project is not None else [ctx.tree]
+    )
+    own: Dict[str, Set[str]] = {}
+    bases: Dict[str, List[str]] = {}
+    for tree in trees:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            fields = own.setdefault(node.name, set())
+            for stmt in node.body:
+                if (
+                    isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)
+                    and _is_float_annotation(stmt.annotation)
+                ):
+                    fields.add(stmt.target.id)
+            for b in node.bases:
+                base_name = (dotted(b) or "").split(".")[-1]
+                if base_name:
+                    bases.setdefault(node.name, []).append(base_name)
+    # propagate inherited fields to a fixed point (hierarchies are shallow;
+    # same-named classes in different modules merge — a safe over-approximation)
+    changed = True
+    while changed:
+        changed = False
+        for cls, base_list in bases.items():
+            for b in base_list:
+                if b in own and not own[b] <= own.setdefault(cls, set()):
+                    own[cls] |= own[b]
+                    changed = True
+    index = {c: f for c, f in own.items() if f}
+    if project is not None:
+        project._rt_float_fields = index  # type: ignore[attr-defined]
+    return index
+
+
+def _is_array_call(node: ast.AST) -> bool:
+    """A call that produces (or consumes) traced arrays: ``jnp.*``, ``jax.*``,
+    ``lax.*``, ``np.*``."""
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted(node.func)
+    return d is not None and "." in d and d.split(".")[0] in (_ARRAY_ROOTS | {"lax"})
+
+
+def _array_derived_names(body: ast.AST) -> Set[str]:
+    """Names in ``body`` assigned (source order) from an expression containing
+    an array-library call or a previously array-derived name."""
+    assigns = sorted(
+        (n for n in ast.walk(body) if isinstance(n, ast.Assign)),
+        key=lambda a: a.lineno,
+    )
+    derived: Set[str] = set()
+    for a in assigns:
+        v = a.value
+        has_array = any(_is_array_call(sub) for sub in ast.walk(v))
+        if has_array or (_names_in(v) & derived):
+            derived.update(t.id for t in a.targets if isinstance(t, ast.Name))
+    return derived
+
+
+def _has_array_math(node: ast.AST, derived: Set[str]) -> bool:
+    """Evidence that ``node`` is traced-array math: an array-library call, a
+    matmul, or a name assigned from one."""
+    for sub in ast.walk(node):
+        if _is_array_call(sub):
+            return True
+        if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.MatMult):
+            return True
+        if isinstance(sub, ast.Name) and sub.id in derived:
+            return True
+    return False
+
+
+def _float_field_side(side: ast.AST, fields: Set[str]) -> Optional[ast.Attribute]:
+    """The ``self.<float_field>`` a scalar BinOp side resolves to: the side IS
+    the attribute, or a pure-scalar expression over constants and self
+    attributes (the ``self.alpha / self.r`` scaling idiom). Anything touching
+    a local name is out of scope — too noisy for CFG-lite reasoning."""
+    if isinstance(side, ast.Attribute):
+        if (
+            isinstance(side.value, ast.Name)
+            and side.value.id == "self"
+            and side.attr in fields
+        ):
+            return side
+        return None
+    if not isinstance(side, (ast.BinOp, ast.UnaryOp)):
+        return None
+    found: Optional[ast.Attribute] = None
+    for sub in ast.walk(side):
+        if isinstance(sub, (ast.BinOp, ast.UnaryOp, ast.Constant)):
+            continue
+        if isinstance(sub, (ast.operator, ast.unaryop, ast.expr_context)):
+            continue
+        if isinstance(sub, ast.Name) and sub.id == "self":
+            continue
+        if isinstance(sub, ast.Attribute) and isinstance(sub.value, ast.Name) and sub.value.id == "self":
+            if sub.attr in fields:
+                found = sub
+            continue
+        return None
+    return found
+
+
+def _self_float_attrs(node: ast.AST, fields: Set[str]):
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Attribute)
+            and isinstance(sub.value, ast.Name)
+            and sub.value.id == "self"
+            and sub.attr in fields
+        ):
+            yield sub
+
+
+def _poly_shape_reason(arg: ast.AST, len_derived: Set[str], poly_shaped: Set[str],
+                       sanctioned_fns: frozenset) -> Optional[str]:
+    """Why this argument's shape varies across calls, or None."""
+    if _sanctioned_call_in(arg, sanctioned_fns):
+        return None
+    if isinstance(arg, ast.Name):
+        if arg.id in poly_shaped:
+            return f"`{arg.id}` was built with a len()-derived dimension"
+        return None
+    if isinstance(arg, ast.Call) and _is_array_shape_ctor(arg):
+        if any(_is_len_call(sub) for sub in ast.walk(arg)):
+            return "its shape contains a raw len()"
+        if _names_in(arg) & len_derived:
+            return "its shape uses a len()-derived value"
+        return None
+    if isinstance(arg, ast.Subscript):
+        sl = arg.slice
+        if any(_is_len_call(sub) for sub in ast.walk(sl)) or (_names_in(sl) & len_derived):
+            return "it is sliced to a len()-derived extent"
+    return None
+
+
+@register
+class SH001ShapePolymorphicJit(Rule):
+    id = "SH001"
+    summary = (
+        "shape-polymorphic jit call site: a len()-derived dimension reaches a "
+        "jitted callable without a registered bucketing ladder"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        al = collect_aliases(ctx.tree)
+        targets = _collect_jit_targets(ctx, al)
+        if not targets:
+            return
+        sanctioned = contracts.quantizer_names() | contracts.guard_names()
+        for call, tgt in _jit_call_sites(ctx, targets):
+            assigns = _enclosing_scope_assigns(ctx, call)
+            len_derived, _s, poly_shaped, _f, _l = _classify_scope_names(assigns, sanctioned)
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                reason = _poly_shape_reason(arg, len_derived, poly_shaped, sanctioned)
+                if reason is not None:
+                    yield self.finding(
+                        ctx, call,
+                        f"argument of jitted `{tgt.name}` varies shape across calls "
+                        f"({reason}); route it through a registered bucketing ladder "
+                        f"({', '.join(sorted(contracts.quantizer_names())[:3])}, ...) "
+                        f"or declare a new shape contract in analysis/rt/contracts.py",
+                    )
+                    break  # one finding per call site
+
+
+@register
+class SH002WeakTypeDrift(Rule):
+    id = "SH002"
+    summary = (
+        "weak-type drift: a Python float reaches a jitted operand, splitting "
+        "the jit cache on weak_type"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        al = collect_aliases(ctx.tree)
+        yield from self._check_call_sites(ctx, al)
+        yield from self._check_float_fields(ctx)
+
+    def _check_call_sites(self, ctx: FileContext, al) -> Iterable[Finding]:
+        targets = _collect_jit_targets(ctx, al)
+        if not targets:
+            return
+        sanctioned = contracts.quantizer_names()
+        for call, tgt in _jit_call_sites(ctx, targets):
+            static_names = set(tgt.static_argnames)
+            assigns = _enclosing_scope_assigns(ctx, call)
+            _ld, _s, _p, float_bound, _lb = _classify_scope_names(assigns, sanctioned)
+            for i, arg in enumerate(list(call.args) + [kw.value for kw in call.keywords]):
+                # statically-marked params hash by value on purpose — SH003's
+                # jurisdiction, not a weak-type hazard
+                if i < len(call.args) and i in tgt.static_argnums:
+                    continue
+                kw_i = i - len(call.args)
+                if kw_i >= 0 and call.keywords[kw_i].arg in static_names:
+                    continue
+                hazard = None
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, float):
+                    hazard = f"float literal {arg.value!r}"
+                elif (
+                    isinstance(arg, ast.Call)
+                    and isinstance(arg.func, ast.Name)
+                    and arg.func.id == "float"
+                ):
+                    hazard = "float(...) conversion"
+                elif isinstance(arg, ast.Name) and arg.id in float_bound:
+                    hazard = f"`{arg.id}` holds a Python float"
+                if hazard is not None:
+                    yield self.finding(
+                        ctx, call,
+                        f"{hazard} passed to jitted `{tgt.name}`: traces as a "
+                        f"weak_type scalar and splits the jit cache; wrap with "
+                        f"jnp.asarray(x, dtype) at the boundary",
+                    )
+
+    def _check_float_fields(self, ctx: FileContext) -> Iterable[Finding]:
+        """Float dataclass fields (``self.vf_coef``-style hyperparameters)
+        entering traced math: inside the arguments of a ``jnp``/``lax`` call,
+        or one side of a BinOp whose other side is array-derived. These trace
+        as weak_type scalars each time the method body is (re)traced — the
+        exact promotion/cache seam the call-site check sees from the outside.
+        ``jnp.asarray(self.x, dtype)`` is the sanctioned pin and is exempt."""
+        index = _float_fields_index(ctx)
+        if not index:
+            return
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef) or cls.name not in index:
+                continue
+            fields = index[cls.name]
+            for method in cls.body:
+                if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                derived = _array_derived_names(method)
+                seen: Set[Tuple[int, str]] = set()
+                for node in ast.walk(method):
+                    hits: List[ast.Attribute] = []
+                    if _is_array_call(node):
+                        if dotted(node.func).split(".")[-1] in _DTYPE_PIN_CALLS:
+                            continue
+                        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                            # a pinned sub-expression inside a bigger call is
+                            # also fine: jnp.clip(x, jnp.asarray(self.c, dt))
+                            hits.extend(
+                                a for a in _self_float_attrs(arg, fields)
+                                if not self._pinned(ctx, a)
+                            )
+                    elif isinstance(node, ast.BinOp):
+                        for side, other in ((node.left, node.right), (node.right, node.left)):
+                            attr = _float_field_side(side, fields)
+                            if attr is not None and _has_array_math(other, derived):
+                                hits.append(attr)
+                    for attr in hits:
+                        key = (attr.lineno, attr.attr)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        yield self.finding(
+                            ctx, attr,
+                            f"float field `self.{attr.attr}` enters traced math as a "
+                            f"weak_type scalar (dtype-promotion drift, and a jit-cache "
+                            f"split against strongly-typed callers); pin it once with "
+                            f"jnp.asarray(self.{attr.attr}, dtype) at the top of the "
+                            f"method",
+                        )
+
+    def _pinned(self, ctx: FileContext, attr: ast.Attribute) -> bool:
+        """True when ``attr`` sits inside an asarray/array pin call."""
+        if not hasattr(ctx, "_rt_parents"):
+            ctx._rt_parents = astutils.build_parents(ctx.tree)  # type: ignore[attr-defined]
+        parents = ctx._rt_parents  # type: ignore[attr-defined]
+        node = attr
+        while node in parents:
+            node = parents[node]
+            if _is_array_call(node) and dotted(node.func).split(".")[-1] in _DTYPE_PIN_CALLS:
+                return True
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                return False
+        return False
+
+
+@register
+class SH003UnstableStatic(Rule):
+    id = "SH003"
+    summary = (
+        "unstable static argument: a float/dict/fresh-lambda static churns "
+        "the jit cache (one compile per value or per call)"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        al = collect_aliases(ctx.tree)
+        targets = _collect_jit_targets(ctx, al)
+        statics = [t for t in targets if t.static_argnums or t.static_argnames]
+        if not statics:
+            return
+        sanctioned = contracts.quantizer_names()
+        for call, tgt in _jit_call_sites(ctx, statics):
+            assigns = _enclosing_scope_assigns(ctx, call)
+            _ld, _s, _p, float_bound, lambda_bound = _classify_scope_names(assigns, sanctioned)
+            checked: List[Tuple[str, ast.AST]] = []
+            for i in tgt.static_argnums:
+                if i < len(call.args):
+                    checked.append((f"positional {i}", call.args[i]))
+            for kw in call.keywords:
+                if kw.arg in tgt.static_argnames:
+                    checked.append((f"keyword {kw.arg!r}", kw.value))
+            for where, arg in checked:
+                hazard = None
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, float):
+                    hazard = "a float (the cache keys on every distinct value)"
+                elif (
+                    isinstance(arg, ast.Call)
+                    and isinstance(arg.func, ast.Name)
+                    and arg.func.id == "float"
+                ):
+                    hazard = "a float(...) result (the cache keys on every distinct value)"
+                elif isinstance(arg, (ast.Dict, ast.List, ast.Set)):
+                    hazard = "an unhashable display (TypeError at the jit boundary)"
+                elif isinstance(arg, ast.Lambda):
+                    hazard = "a fresh lambda (new identity per call: one compile per call)"
+                elif isinstance(arg, ast.Name) and arg.id in lambda_bound:
+                    hazard = (
+                        f"`{arg.id}`, a lambda created in this scope (new identity "
+                        f"per call: one compile per call)"
+                    )
+                elif isinstance(arg, ast.Name) and arg.id in float_bound:
+                    hazard = f"`{arg.id}`, a float (the cache keys on every distinct value)"
+                if hazard is not None:
+                    yield self.finding(
+                        ctx, call,
+                        f"static {where} of jitted `{tgt.name}` is {hazard}; pass it "
+                        f"as a traced operand, hoist it to a module-level callable, "
+                        f"or key the cache deliberately",
+                    )
+
+
+@register
+class SH004DataDependentShape(Rule):
+    id = "SH004"
+    summary = (
+        "data-dependent output shape under jit: nonzero/boolean-mask/traced "
+        "slice bound cannot compile to a fixed shape"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        al = collect_aliases(ctx.tree)
+        if ctx.project is not None:
+            roots = ctx.project.traced_roots(ctx)
+        else:
+            if not (al.jax or al.jit):
+                return
+            roots = astutils.traced_roots(ctx.tree, al)
+        for root in roots:
+            # names bound from comparisons inside this traced body: boolean
+            # masks for the subscript check below
+            bool_bound: Set[str] = set()
+            for node in ast.walk(root):
+                if isinstance(node, ast.Assign) and isinstance(node.value, (ast.Compare, ast.BoolOp)):
+                    bool_bound.update(t.id for t in node.targets if isinstance(t, ast.Name))
+            for node in ast.walk(root):
+                if isinstance(node, ast.Call):
+                    d = dotted(node.func)
+                    last = d.split(".")[-1] if d else None
+                    if last in _DATA_DEP_CALLS:
+                        # `size=` is the sanctioned fixed-shape escape hatch
+                        if any(kw.arg == "size" for kw in node.keywords):
+                            continue
+                        yield self.finding(
+                            ctx, node,
+                            f"`{last}` under jit has a data-dependent output shape; "
+                            f"pass size=/fill_value= or keep the mask and reduce",
+                        )
+                    elif last == "where" and len(node.args) == 1 and not node.keywords:
+                        yield self.finding(
+                            ctx, node,
+                            "single-argument `where` under jit returns a "
+                            "data-dependent shape; use the three-argument form "
+                            "or nonzero(..., size=)",
+                        )
+                elif isinstance(node, ast.Subscript):
+                    sl = node.slice
+                    if isinstance(sl, (ast.Compare, ast.BoolOp)) or (
+                        isinstance(sl, ast.Name) and sl.id in bool_bound
+                    ):
+                        yield self.finding(
+                            ctx, node,
+                            "boolean-mask indexing under jit produces a "
+                            "data-dependent shape; use jnp.where(mask, x, fill) "
+                            "or carry the mask to the reduction",
+                        )
+                    elif isinstance(sl, ast.Slice):
+                        for bound in (sl.lower, sl.upper):
+                            if bound is None:
+                                continue
+                            for sub in ast.walk(bound):
+                                if isinstance(sub, ast.Call):
+                                    d = dotted(sub.func)
+                                    if (
+                                        d is not None
+                                        and d.split(".")[-1] in _TRACED_REDUCTIONS
+                                        and d.split(".")[0] in _ARRAY_ROOTS
+                                    ):
+                                        yield self.finding(
+                                            ctx, node,
+                                            f"slice bound computed by traced "
+                                            f"`{d.split('.')[-1]}` is a data-dependent "
+                                            f"shape under jit; use lax.dynamic_slice "
+                                            f"with a fixed extent or mask instead",
+                                        )
+                                        break

@@ -1,0 +1,39 @@
+"""Seeded recompile regressions: prove the steady-state gate fails closed.
+
+Mirrors ``TRLX_IR_SEED_REGRESSION`` / ``TRLX_CONC_SEED_REGRESSION``, but the
+rt defect is *behavioral*, not syntactic: ``TRLX_RT_SEED_REGRESSION=
+shape_churn`` makes the streamed-scoring quantizer
+(:func:`trlx_tpu.trainer.ppo_trainer.quantize_stream_response`) return raw
+response lengths instead of snapping them onto the pow2 ladder — exactly the
+unbucketed-shape-seam bug class SH001 and the compile gate exist for. Under
+the seed every distinct completion length is a fresh jit-cache entry, the
+``stream_score_bucket`` probe sees nonzero steady-state compiles, and
+``python -m trlx_tpu.analysis.rt`` must exit 1 (``scripts/ci.sh`` proves it).
+
+The seed check lives in the *production* quantizer so the gate exercises the
+real ladder path, not a test double. ``budget.write`` refuses to regenerate
+while a seed is active.
+"""
+
+import os
+from typing import Optional
+
+ENV_VAR = "TRLX_RT_SEED_REGRESSION"
+
+SEEDS = ("shape_churn",)
+
+
+def active() -> Optional[str]:
+    """The active seed name, validated; None when unset."""
+    seed = os.environ.get(ENV_VAR)
+    if not seed:
+        return None
+    if seed not in SEEDS:
+        raise ValueError(f"unknown {ENV_VAR} seed {seed!r}; known: {', '.join(SEEDS)}")
+    return seed
+
+
+def shape_churn() -> bool:
+    """True when the streamed-scoring quantizer must misbehave (return raw,
+    unbucketed lengths)."""
+    return active() == "shape_churn"

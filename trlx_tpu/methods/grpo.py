@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from trlx_tpu.analysis.ir.entrypoints import EntryArtifacts, register_entrypoint
 from trlx_tpu.data.method_configs import register_method
 from trlx_tpu.methods.ppo import PPOConfig, gae_advantages_and_returns
 from trlx_tpu.utils.modeling import masked_mean
@@ -152,6 +153,9 @@ class GRPOConfig(PPOConfig):
         per-step policy movement the online loop exports as
         ``online/policy_delta``."""
         mask = mask.astype(logprobs.dtype)
+        # pin the clip range once (SH002): a bare Python float would trace as
+        # a weak_type scalar and split the jit cache on weak_type
+        cliprange = jnp.asarray(self.cliprange, logprobs.dtype)
         # f32-pinned reductions throughout: operands may be bf16 on TPU and
         # sequence-length sums lose exactly the small clipped terms (JX007)
         n = jnp.maximum(mask.sum(dtype=jnp.float32), 1.0)
@@ -174,7 +178,7 @@ class GRPOConfig(PPOConfig):
 
         pg_loss1 = -advantages * ratio
         pg_loss2 = -advantages * jnp.clip(
-            ratio, 1.0 - self.cliprange, 1.0 + self.cliprange
+            ratio, 1.0 - cliprange, 1.0 + cliprange
         )
         pg_loss = jnp.sum(
             jnp.maximum(pg_loss1, pg_loss2) * mask, dtype=jnp.float32
@@ -211,3 +215,19 @@ class GRPOConfig(PPOConfig):
                 is_weight_mean=jnp.sum(is_weights * mask, dtype=jnp.float32) / n,
             )
         return loss, stats
+
+
+# -- AOT audit surface (graftcheck-ir / graftcheck-rt) ------------------------
+
+
+@register_entrypoint("grpo_train_step", specs=("small",))
+def build_grpo_train_step(spec: str, mesh) -> EntryArtifacts:
+    """The GRPO learner step at audit shapes: PPO's shared step construction
+    (grad-accum scan + adamw update) with :class:`GRPOConfig`'s critic-free
+    loss swapped in — one builder (``methods/ppo.py:_build_train_step``), two
+    methods, which is the parity the GRPO tests pin. The rt compile-budget
+    probe executes this same artifact to prove the step compiles once and
+    never again in steady state."""
+    from trlx_tpu.methods.ppo import _build_train_step
+
+    return _build_train_step(spec, mesh, GRPOConfig())

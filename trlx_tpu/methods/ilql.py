@@ -66,6 +66,14 @@ class ILQLConfig(MethodConfig):
         """
         logits, (qs, target_qs, vs) = outputs
         terminal_mask = batch.dones[:, :-1].astype(vs.dtype)
+        # pin the float hyperparameters to concrete dtypes once (SH002): bare
+        # Python floats would trace as weak_type scalars, splitting the jit
+        # cache on weak_type and drifting promotion on bf16 operands
+        gamma = jnp.asarray(self.gamma, vs.dtype)
+        tau = jnp.asarray(self.tau, vs.dtype)
+        beta = jnp.asarray(self.beta, vs.dtype)
+        cql_scale = jnp.asarray(self.cql_scale, jnp.float32)
+        awac_scale = jnp.asarray(self.awac_scale, jnp.float32)
         # loss sums pin dtype=float32: Q/V are f32 by head design but the CE
         # term multiplies in logits-derived terms that are bf16 on TPU
         # (JX007 discipline)
@@ -90,11 +98,11 @@ class ILQLConfig(MethodConfig):
 
         V = vs[:, :-1, 0]
         Vnext = vs[:, 1:, 0] * batch.dones[:, 1:].astype(vs.dtype)
-        Q_ = batch.rewards + self.gamma * jax.lax.stop_gradient(Vnext)
+        Q_ = batch.rewards + gamma * jax.lax.stop_gradient(Vnext)
 
         loss_q = sum(jnp.sum(((Qi - Q_) * terminal_mask) ** 2, dtype=jnp.float32) / n_nonterminal for Qi in Q)
 
-        expectile_w = jnp.where(targetQ >= V, self.tau, 1.0 - self.tau)
+        expectile_w = jnp.where(targetQ >= V, tau, 1.0 - tau)
         loss_v = jnp.sum(expectile_w * (targetQ - V) ** 2 * terminal_mask, dtype=jnp.float32) / n_nonterminal
 
         def cql_loss(q):
@@ -105,10 +113,10 @@ class ILQLConfig(MethodConfig):
         loss_cql = sum(cql_loss(q) for q in qs)
 
         ce = -jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1), actions[..., None], axis=-1)[..., 0]
-        awac_weight = jax.lax.stop_gradient(jnp.exp(self.beta * (targetQ - V)))
+        awac_weight = jax.lax.stop_gradient(jnp.exp(beta * (targetQ - V)))
         loss_awac = jnp.sum(ce * awac_weight * terminal_mask, dtype=jnp.float32) / n_nonterminal
 
-        loss = loss_q + loss_v + self.cql_scale * loss_cql + self.awac_scale * loss_awac
+        loss = loss_q + loss_v + cql_scale * loss_cql + awac_scale * loss_awac
 
         stats = dict(
             losses=dict(

@@ -166,13 +166,20 @@ class PPOConfig(MethodConfig):
         exactly 1.0 at staleness 0, keeping on-policy losses bitwise-identical
         to the vanilla path."""
         mask = mask.astype(values.dtype)
+        # pin the float hyperparameters to concrete dtypes once (SH002): as
+        # bare Python floats each use would trace as a weak_type scalar,
+        # splitting the jit cache on weak_type and letting promotion drift on
+        # bf16 operands
+        cliprange = jnp.asarray(self.cliprange, logprobs.dtype)
+        cliprange_value = jnp.asarray(self.cliprange_value, values.dtype)
+        vf_coef = jnp.asarray(self.vf_coef, jnp.float32)
         # every loss accumulation pins dtype=float32: operands may be bf16 on
         # TPU, and a sequence-length sum in bf16 loses the low bits of exactly
         # the small per-token terms PPO clips on (JX007 discipline)
         n = jnp.maximum(mask.sum(dtype=jnp.float32), 1.0)
 
         values_clipped = jnp.clip(
-            values, old_values - self.cliprange_value, old_values + self.cliprange_value
+            values, old_values - cliprange_value, old_values + cliprange_value
         )
         vf_loss1 = (values - returns) ** 2
         vf_loss2 = (values_clipped - returns) ** 2
@@ -195,11 +202,11 @@ class PPOConfig(MethodConfig):
             advantages = advantages * is_weights
 
         pg_loss1 = -advantages * ratio
-        pg_loss2 = -advantages * jnp.clip(ratio, 1.0 - self.cliprange, 1.0 + self.cliprange)
+        pg_loss2 = -advantages * jnp.clip(ratio, 1.0 - cliprange, 1.0 + cliprange)
         pg_loss = jnp.sum(jnp.maximum(pg_loss1, pg_loss2) * mask, dtype=jnp.float32) / n
         pg_clipfrac = jnp.sum((pg_loss2 > pg_loss1).astype(mask.dtype) * mask, dtype=jnp.float32) / n
 
-        loss = pg_loss + self.vf_coef * vf_loss
+        loss = pg_loss + vf_coef * vf_loss
 
         stats = dict(
             losses=dict(total_loss=loss, policy_loss=pg_loss, value_loss=vf_loss),
@@ -247,6 +254,14 @@ def build_ppo_train_step(spec: str, mesh) -> EntryArtifacts:
     constraint whose all-gather must break the IR005 budget) so CI can prove
     the gate fails closed.
     """
+    return _build_train_step(spec, mesh, PPOConfig())
+
+
+def _build_train_step(spec: str, mesh, method) -> EntryArtifacts:
+    """The shared audit-shape learner-step construction behind the
+    ``ppo_train_step`` and ``grpo_train_step`` entrypoints — GRPO inherits
+    PPO's step plumbing wholesale (methods/grpo.py), so the audit surface is
+    one builder parameterized by the method, not two drifting copies."""
     import os
 
     import optax
@@ -267,7 +282,6 @@ def build_ppo_train_step(spec: str, mesh) -> EntryArtifacts:
         param_dtype=jnp.float32, compute_dtype=jnp.bfloat16,
     )
     module = CausalLMWithValueHead(model_config)
-    method = PPOConfig()
     seed_regression = os.environ.get("TRLX_IR_SEED_REGRESSION", "")
 
     params_shape = jax.eval_shape(

@@ -68,6 +68,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from trlx_tpu.analysis.rt import watcher as rt_watcher
 from trlx_tpu.obs.flight import flight
 from trlx_tpu.ops.generation import left_pad_batch, pad_to_bucket
 from trlx_tpu.ops.sampling import count_accepted_drafts, sample_token
@@ -554,11 +555,12 @@ class ServingEngine:
             counts = np.zeros((n_b,), np.int32)
             for i, (_, req, _) in enumerate(group):
                 counts[i] = len(req.generated)
-            tok, cont, self._rng = self._prefill(
-                self.params,  # graftcheck: noqa[TH001] — under step()'s lock
-                jnp.asarray(ids), jnp.asarray(mask), self._rng,
-                jnp.asarray(counts) if counts.any() else None,
-            )
+            with rt_watcher.attributed("serving_prefill"):
+                tok, cont, self._rng = self._prefill(
+                    self.params,  # graftcheck: noqa[TH001] — under step()'s lock
+                    jnp.asarray(ids), jnp.asarray(mask), self._rng,
+                    jnp.asarray(counts) if counts.any() else None,
+                )
             rows = np.zeros((n_b, self.max_blocks_per_seq), np.int32)
             lens = np.zeros((n_b,), np.int32)
             for i, (slot, req, first) in enumerate(group):
@@ -570,7 +572,8 @@ class ServingEngine:
                 if k not in ("block_tables", "context_lens")
             }
             cont_pools = {k: cont[k] for k in pools}
-            packed = self._pack(pools, cont_pools, jnp.asarray(rows), jnp.asarray(lens))
+            with rt_watcher.attributed("serving_pack_step"):
+                packed = self._pack(pools, cont_pools, jnp.asarray(rows), jnp.asarray(lens))
             self.cache.update(packed)
             tok_np = np.asarray(jax.device_get(tok))
             self.stats.prefill_waves += 1
@@ -625,12 +628,13 @@ class ServingEngine:
             cache1 = {key: self.cache[key] for key in pool_keys}
             cache1["block_tables"] = jnp.asarray(row)
             cache1["context_lens"] = jnp.asarray(np.array([start], np.int32))
-            tok, pools, self._rng = self._chunk_step(
-                self.params,  # graftcheck: noqa[TH001] — under step()'s lock
-                jnp.asarray(ids), cache1, self._rng,
-                jnp.asarray(np.array([n_v - 1], np.int32)),
-                jnp.asarray(np.array([len(req.generated)], np.int32)),
-            )
+            with rt_watcher.attributed("serving_chunk_step"):
+                tok, pools, self._rng = self._chunk_step(
+                    self.params,  # graftcheck: noqa[TH001] — under step()'s lock
+                    jnp.asarray(ids), cache1, self._rng,
+                    jnp.asarray(np.array([n_v - 1], np.int32)),
+                    jnp.asarray(np.array([len(req.generated)], np.int32)),
+                )
             self.cache.update(pools)
             req.prefilled = start + n_v
             self._lens[slot] = req.prefilled
@@ -819,11 +823,12 @@ class ServingEngine:
         if self.spec_k > 0:
             finished.extend(self._spec_round(live, new_counts))
         else:
-            next_tok, self.cache, self._rng = self._decode_step(
-                self.params,  # graftcheck: noqa[TH001] — under step()'s lock
-                jnp.asarray(self._pending_tok), self.cache,
-                self._rng, jnp.asarray(new_counts),
-            )
+            with rt_watcher.attributed("serving_decode_step"):
+                next_tok, self.cache, self._rng = self._decode_step(
+                    self.params,  # graftcheck: noqa[TH001] — under step()'s lock
+                    jnp.asarray(self._pending_tok), self.cache,
+                    self._rng, jnp.asarray(new_counts),
+                )
             # device lens advanced for every slot; mirror so a no-admission
             # next step needs no host->device sync
             self._lens += 1
@@ -856,10 +861,11 @@ class ServingEngine:
                 self.spec_ngram, self.pad_token_id,
             )
         tok = np.concatenate([self._pending_tok[:, None], drafts], axis=1)
-        y, accepted, self.cache, self._rng = self._verify_step(
-            self.params,  # graftcheck: noqa[TH001] — under step()'s lock
-            jnp.asarray(tok), self.cache, self._rng, jnp.asarray(new_counts),
-        )
+        with rt_watcher.attributed("serving_verify_step"):
+            y, accepted, self.cache, self._rng = self._verify_step(
+                self.params,  # graftcheck: noqa[TH001] — under step()'s lock
+                jnp.asarray(tok), self.cache, self._rng, jnp.asarray(new_counts),
+            )
         acc_np = np.asarray(jax.device_get(accepted))
         y_np = np.asarray(jax.device_get(y))
         # device advanced EVERY slot's frontier by accepted+1 (idle slots

@@ -18,6 +18,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from trlx_tpu.analysis.rt import contracts as rt_contracts
+from trlx_tpu.analysis.rt import seeds as rt_seeds
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.ppo_types import PPORLBatch, PPORLElement
 from trlx_tpu.methods.ppo import PPOConfig
@@ -43,7 +45,43 @@ logger = logging.get_logger(__name__)
 
 #: Max distinct response-length buckets the streaming path may compile per
 #: (B, P) score-fn family — the recompile bound docs/serving.md documents.
-_STREAM_MAX_R_BUCKETS = 4
+#: Sourced from the declared ``stream_score_ladder`` shape contract
+#: (trlx_tpu/analysis/rt/contracts.py) so the runtime guard, the SH001
+#: sanction list, and the CompileWatcher probe all share one number.
+_STREAM_MAX_R_BUCKETS = rt_contracts.get("stream_score_ladder").max_shapes
+
+#: the shared pow2 padding ladder (8 .. 8192) every bucketing path draws from
+_POW2_BUCKETS = [2 ** i for i in range(3, 14)]
+
+
+def overlap_r_buckets(max_new: int) -> List[int]:
+    """The quantized response-length ladder for streaming microbuckets:
+    ≤ :data:`_STREAM_MAX_R_BUCKETS` pow2 shapes covering up to
+    ``max_new + 1`` (decode may re-append eos)."""
+    from trlx_tpu.ops.generation import pad_to_bucket
+
+    top = max(1, max_new + 1)
+    # ceil(top / d) for d in 8,4,2,1 — dedup after pow2 padding keeps the
+    # ladder at <= 4 entries with the full shape always present
+    return sorted({pad_to_bucket(max(1, -(-top // d)), _POW2_BUCKETS) for d in (8, 4, 2, 1)})
+
+
+def quantize_stream_response(r: int, ladder: List[int]) -> int:
+    """Snap a raw completion length onto the streaming ladder — the ONLY
+    sanctioned path from a data-dependent ``len()`` to the jitted score fn's
+    R dimension (declared in the ``stream_score_ladder`` shape contract).
+
+    ``TRLX_RT_SEED_REGRESSION=shape_churn`` makes this return the raw length
+    — the unbucketed-shape defect the compile gate must catch (ci.sh proves
+    the gate fails closed; see trlx_tpu/analysis/rt/seeds.py)."""
+    from trlx_tpu.ops.generation import pad_to_bucket
+
+    if rt_seeds.shape_churn():
+        return r
+    for cand in ladder:
+        if r <= cand:
+            return cand
+    return pad_to_bucket(r, _POW2_BUCKETS)  # defensive; the ladder covers max_new+1
 
 
 def check_stream_bucket_family(families, B: int, P: int, R: int, limit: int = _STREAM_MAX_R_BUCKETS):
@@ -737,16 +775,9 @@ class PPOTrainer(MeshRLTrainer):
     # --------------------------------------------------- stream-overlapped PPO
 
     def _overlap_r_buckets(self) -> List[int]:
-        """The quantized response-length ladder for streaming microbuckets:
-        ≤ :data:`_STREAM_MAX_R_BUCKETS` pow2 shapes covering up to
-        ``max_new_tokens + 1`` (decode may re-append eos)."""
-        pow2 = [2 ** i for i in range(3, 14)]
-        from trlx_tpu.ops.generation import pad_to_bucket
-
-        top = max(1, self._serving_max_new + 1)
-        # ceil(top / d) for d in 8,4,2,1 — dedup after pow2 padding keeps the
-        # ladder at <= 4 entries with the full shape always present
-        return sorted({pad_to_bucket(max(1, -(-top // d)), pow2) for d in (8, 4, 2, 1)})
+        """The quantized response-length ladder for this run's ``max_new``
+        (module-level :func:`overlap_r_buckets` carries the construction)."""
+        return overlap_r_buckets(self._serving_max_new)
 
     def _make_experience_streamed(
         self, num_rollouts, iter_count, ppo_rl_elements, accumulated_kl, all_scores_log
@@ -785,7 +816,7 @@ class PPOTrainer(MeshRLTrainer):
         serialize = os.environ.get("TRLX_OVERLAP_SEED_REGRESSION", "") == "serialize"
         mb = int(cfg.overlap_microbucket or self.method.chunk_size)
         pad_id = self.tokenizer.pad_token_id
-        pow2 = [2 ** i for i in range(3, 14)]
+        pow2 = _POW2_BUCKETS
         r_ladder = self._overlap_r_buckets()
         # the reward worker threads must not share the main thread's HF fast
         # tokenizer (not re-entrant — same reasoning as overlap_reward_scoring)
@@ -818,10 +849,7 @@ class PPOTrainer(MeshRLTrainer):
             return out
 
         def r_bucket(r):
-            for cand in r_ladder:
-                if r <= cand:
-                    return cand
-            return pad_to_bucket(r, pow2)  # defensive; the ladder covers max_new+1
+            return quantize_stream_response(r, r_ladder)
 
         def dispatch(items):
             # harvest bucket k-1 first: its device compute had a full bucket's
